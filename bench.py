@@ -1,0 +1,186 @@
+"""Headline benchmark: sec/round of 8-client weighted FedAvg on the income MLP.
+
+Prints ONE JSON line:
+    {"metric": "sec_per_round_fedavg8_income_mlp", "value": <ours>,
+     "unit": "s", "vs_baseline": <baseline/ours speedup>}
+
+Ours: the fedtpu compiled round (local full-batch Adam step + in-graph
+weighted FedAvg + in-graph metrics) on the default JAX backend (the TPU chip
+when present), one ('clients',) mesh over the visible devices, 8 clients.
+
+Baseline: the reference publishes no numbers (BASELINE.md), so the baseline is
+MEASURED here as a faithful single-host simulation of the reference's per-round
+work under ``mpirun -np 8`` (FL_CustomMLP...:63-120): per rank a full-batch
+torch forward/backward/Adam step + argmax eval on its shard, then the rank-0
+aggregation path — pickle every rank's weight dict (comm.gather), numpy
+weighted average, pickle the global dict back out (comm.bcast), and load into
+each model. Ranks run concurrently under mpirun, so the compute part is
+divided by min(8, cpu_count) (ideal oversubscription); the serialization +
+averaging path is inherently serialized through rank 0 and is not divided.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import sys
+import time
+
+import numpy as np
+
+ROUNDS = 30
+WARMUP = 3
+NUM_CLIENTS = 8
+
+
+def _dataset():
+    from fedtpu.config import DataConfig, default_income_csv
+
+    from fedtpu.data.tabular import load_tabular_dataset
+
+    csv = default_income_csv()
+    return load_tabular_dataset(DataConfig(csv_path=csv))
+
+
+def bench_fedtpu(ds) -> dict:
+    import jax
+
+    from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+    from fedtpu.data.sharding import pack_clients
+    from fedtpu.models import build_model
+    from fedtpu.ops import build_optimizer
+    from fedtpu.parallel import make_mesh, client_sharding
+    from fedtpu.parallel.round import build_round_fn, init_federated_state
+
+    mesh = make_mesh(num_clients=NUM_CLIENTS)
+    shard = client_sharding(mesh)
+    packed = pack_clients(ds.x_train, ds.y_train,
+                          ShardConfig(num_clients=NUM_CLIENTS))
+    batch = {
+        "x": jax.device_put(packed.x, shard),
+        "y": jax.device_put(packed.y, shard),
+        "mask": jax.device_put(packed.mask, shard),
+    }
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=ds.input_dim,
+                                                num_classes=ds.num_classes))
+    tx = build_optimizer(OptimConfig())
+    state = init_federated_state(jax.random.key(0), mesh, NUM_CLIENTS,
+                                 init_fn, tx)
+    round_step = build_round_fn(mesh, apply_fn, tx, ds.num_classes)
+
+    for _ in range(WARMUP):
+        state, metrics = round_step(state, batch)
+    jax.block_until_ready(state["params"])
+
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        state, metrics = round_step(state, batch)
+    jax.block_until_ready(state["params"])
+    sec_per_round = (time.perf_counter() - t0) / ROUNDS
+    return {"sec_per_round": sec_per_round,
+            "accuracy": float(metrics["client_mean"]["accuracy"]),
+            "devices": len(mesh.devices.ravel()),
+            "backend": mesh.devices.ravel()[0].platform}
+
+
+def bench_reference_equivalent(ds) -> dict:
+    """Measured reference-equivalent baseline; see module docstring."""
+    import torch
+    import torch.nn as nn
+
+    def make_model():
+        # Same architecture as FL_CustomMLP...:12-25, hidden [50, 200] (:40).
+        return nn.Sequential(
+            nn.Linear(ds.input_dim, 50), nn.ReLU(),
+            nn.Linear(50, 200), nn.ReLU(),
+            nn.Linear(200, ds.num_classes))
+
+    torch.set_num_threads(max(1, os.cpu_count() or 1))
+    n = len(ds.x_train)
+    chunk = max(1, n // NUM_CLIENTS)
+    shards = []
+    for r in range(NUM_CLIENTS):
+        s, e = r * chunk, (r + 1) * chunk if r != NUM_CLIENTS - 1 else n
+        shards.append((torch.tensor(ds.x_train[s:e]),
+                       torch.tensor(ds.y_train[s:e], dtype=torch.long)))
+
+    models = [make_model() for _ in range(NUM_CLIENTS)]
+    opts = [torch.optim.Adam(m.parameters(), lr=0.004) for m in models]
+    scheds = [torch.optim.lr_scheduler.StepLR(o, step_size=30, gamma=0.5)
+              for o in opts]
+    crit = nn.CrossEntropyLoss()
+
+    def one_round():
+        t_compute = 0.0
+        t_serial = 0.0
+        gathered = []
+        sizes = []
+        for m, o, sch, (x, y) in zip(models, opts, scheds, shards):
+            t0 = time.perf_counter()
+            # train_one_epoch (:63-73): one full-batch fwd/bwd/Adam step.
+            o.zero_grad()
+            loss = crit(m(x), y)
+            loss.backward()
+            o.step()
+            sch.step()
+            # evaluate_local (:75-91): argmax on the local shard.
+            with torch.no_grad():
+                m(x).argmax(dim=1).numpy()
+            t_compute += time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            # get_weights + comm.gather pickling (:93-94,105).
+            w = {k: v.detach().numpy().copy()
+                 for k, v in m.named_parameters()}
+            gathered.append(pickle.loads(pickle.dumps(w)))
+            sizes.append(len(x))
+            t_serial += time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        # rank-0 weighted average (:108-116).
+        total = sum(sizes)
+        avg = {k: sum(g[k] * (s / total) for g, s in zip(gathered, sizes))
+               for k in gathered[0]}
+        # comm.bcast back out + set_weights (:119-120).
+        for m in models:
+            blob = pickle.loads(pickle.dumps(avg))
+            with torch.no_grad():
+                for k, p in m.named_parameters():
+                    p.copy_(torch.tensor(blob[k]))
+        t_serial += time.perf_counter() - t0
+        return t_compute, t_serial
+
+    one_round()  # warmup
+    reps = 5
+    tc, ts = 0.0, 0.0
+    for _ in range(reps):
+        a, b = one_round()
+        tc += a
+        ts += b
+    tc, ts = tc / reps, ts / reps
+    # mpirun runs ranks concurrently: ideal-parallel compute, serial comm.
+    parallel = min(NUM_CLIENTS, os.cpu_count() or 1)
+    return {"sec_per_round": tc / parallel + ts,
+            "compute_s": tc, "serial_s": ts, "assumed_parallelism": parallel}
+
+
+def main():
+    ds = _dataset()
+    ours = bench_fedtpu(ds)
+    base = bench_reference_equivalent(ds)
+    result = {
+        "metric": "sec_per_round_fedavg8_income_mlp",
+        "value": round(ours["sec_per_round"], 6),
+        "unit": "s",
+        "vs_baseline": round(base["sec_per_round"] / ours["sec_per_round"], 3),
+    }
+    print(json.dumps(result))
+    # Detail lines on stderr so stdout stays one JSON line.
+    print(f"[bench] ours: {ours}", file=sys.stderr)
+    print(f"[bench] baseline(measured reference-equivalent): {base}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""fedtpu — a TPU-native federated-learning framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+``i-HamidZafar/Federated-Learning-with-MPI`` (multi-round weighted FedAvg over
+per-client MLP training, sklearn warm-start parity, federated hyperparameter
+grid search). The reference runs one MPI process per federated client and moves
+model weights through rank-0 with pickled ``comm.gather``/``comm.bcast``
+(FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:101-120); fedtpu runs
+one client per TPU-core shard of a ``('clients',)`` ``jax.sharding.Mesh`` and
+aggregates with ``jax.lax.psum`` over ICI inside a single jit-compiled round —
+weights never leave device memory.
+
+Public API (stable):
+    fedtpu.config      — typed configs + the BASELINE.json presets
+    fedtpu.data        — CSV pipeline, client sharding (IID / non-IID), packing
+    fedtpu.models      — pure-pytree MLP and ConvNet
+    fedtpu.ops         — losses, in-graph classification metrics, optimizers
+    fedtpu.parallel    — mesh helpers, the shard_map federated round
+    fedtpu.orchestration — host round loop, early stopping, checkpointing
+    fedtpu.sweep       — federated hyperparameter grid search
+    fedtpu.parity      — sklearn MLPClassifier warm-start comparison path
+"""
+
+__version__ = "0.1.0"
+
+from fedtpu.config import (  # noqa: F401
+    DataConfig,
+    ShardConfig,
+    ModelConfig,
+    OptimConfig,
+    FedConfig,
+    RunConfig,
+    ExperimentConfig,
+    PRESETS,
+    get_preset,
+)

@@ -1,0 +1,149 @@
+"""Command-line entry point — the config/flag layer the reference never had.
+
+The reference's launch story is ``mpirun -np N python <script>.py`` with every
+hyperparameter hardcoded (SURVEY.md §1 L6); changing the client count means
+changing the mpirun invocation, changing anything else means editing source.
+fedtpu: ``python -m fedtpu.cli run --preset income-8 [overrides]`` on the TPU
+host — no launcher, the mesh IS the topology.
+
+Subcommands:
+    run    — run a federated experiment from a preset + CLI overrides
+    sweep  — the 90-config hyperparameter grid (hyperparameters_tuning.py)
+    parity — the sklearn MLPClassifier warm-start limitation demo (FL_SkLearn...)
+    presets — list shipped presets
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from fedtpu.config import PRESETS, get_preset, ExperimentConfig
+
+
+def _hidden_sizes(text: str):
+    try:
+        return tuple(int(s) for s in text.split(","))
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected comma-separated integers, got {text!r}")
+
+
+def _add_common_overrides(p: argparse.ArgumentParser):
+    p.add_argument("--preset", default="income-8", choices=sorted(PRESETS))
+    p.add_argument("--csv", default=None, help="dataset CSV path")
+    p.add_argument("--label-column", default=None)
+    p.add_argument("--num-clients", type=int, default=None)
+    p.add_argument("--rounds", type=int, default=None)
+    p.add_argument("--hidden-sizes", type=_hidden_sizes, default=None,
+                   help="comma-separated, e.g. 50,200")
+    p.add_argument("--learning-rate", type=float, default=None)
+    p.add_argument("--weighting", choices=["data_size", "uniform"], default=None)
+    p.add_argument("--shard-strategy",
+                   choices=["contiguous", "label_sort", "dirichlet"],
+                   default=None)
+    p.add_argument("--compute-dtype", choices=["float32", "bfloat16"],
+                   default=None)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=None)
+    p.add_argument("--eval-test-every", type=int, default=None)
+    p.add_argument("--log-per-client", action="store_true")
+    p.add_argument("--quiet", action="store_true")
+    p.add_argument("--json", action="store_true",
+                   help="print the result summary as one JSON line")
+
+
+def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
+    data, shard, model = cfg.data, cfg.shard, cfg.model
+    optim, fed, run = cfg.optim, cfg.fed, cfg.run
+    if args.csv is not None:
+        # --csv "" explicitly selects the synthetic dataset.
+        data = dataclasses.replace(data, csv_path=args.csv or None)
+    if args.label_column is not None:
+        data = dataclasses.replace(data, label_column=args.label_column)
+    if args.num_clients is not None:
+        shard = dataclasses.replace(shard, num_clients=args.num_clients)
+    if args.shard_strategy is not None:
+        shard = dataclasses.replace(shard, strategy=args.shard_strategy)
+    if args.hidden_sizes is not None:
+        model = dataclasses.replace(model, hidden_sizes=args.hidden_sizes)
+    if args.compute_dtype is not None:
+        model = dataclasses.replace(model, compute_dtype=args.compute_dtype)
+    if args.learning_rate is not None:
+        optim = dataclasses.replace(optim, learning_rate=args.learning_rate)
+    if args.rounds is not None:
+        fed = dataclasses.replace(fed, rounds=args.rounds)
+    if args.weighting is not None:
+        fed = dataclasses.replace(fed, weighting=args.weighting)
+    run_kw = {}
+    if args.checkpoint_dir is not None:
+        run_kw["checkpoint_dir"] = args.checkpoint_dir
+    if args.checkpoint_every is not None:
+        run_kw["checkpoint_every"] = args.checkpoint_every
+    if args.eval_test_every is not None:
+        run_kw["eval_test_every"] = args.eval_test_every
+    if args.log_per_client:
+        run_kw["log_per_client"] = True
+    if run_kw:
+        run = dataclasses.replace(run, **run_kw)
+    return ExperimentConfig(data=data, shard=shard, model=model, optim=optim,
+                            fed=fed, run=run)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fedtpu", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    run_p = sub.add_parser("run", help="run a federated experiment")
+    _add_common_overrides(run_p)
+    run_p.add_argument("--resume", action="store_true",
+                       help="resume from the latest checkpoint in "
+                            "--checkpoint-dir")
+
+    sweep_p = sub.add_parser("sweep", help="federated hyperparameter grid")
+    _add_common_overrides(sweep_p)
+    sweep_p.add_argument("--no-vmap-lr", action="store_true",
+                         help="run learning rates sequentially instead of "
+                              "vmapped (parity-check path; ~9x slower)")
+
+    parity_p = sub.add_parser("parity",
+                              help="sklearn warm-start limitation demo")
+    _add_common_overrides(parity_p)
+
+    sub.add_parser("presets", help="list shipped presets")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "presets":
+        for name, preset in sorted(PRESETS.items()):
+            print(f"{name}: clients={preset.shard.num_clients} "
+                  f"model={preset.model.kind}{list(preset.model.hidden_sizes)} "
+                  f"rounds={preset.fed.rounds} weighting={preset.fed.weighting}")
+        return 0
+
+    cfg = _apply_overrides(get_preset(args.preset), args)
+
+    if args.cmd == "run":
+        from fedtpu.orchestration.loop import run_experiment
+        result = run_experiment(cfg, verbose=not args.quiet,
+                                resume=args.resume)
+        summary = result.summary()
+    elif args.cmd == "sweep":
+        from fedtpu.sweep.grid import run_grid_search
+        summary = run_grid_search(cfg, vmap_lr=not args.no_vmap_lr,
+                                  verbose=not args.quiet)
+    elif args.cmd == "parity":
+        from fedtpu.parity.sklearn_warmstart import run_parity_demo
+        summary = run_parity_demo(cfg, verbose=not args.quiet)
+    else:  # pragma: no cover
+        parser.error(f"unknown command {args.cmd}")
+
+    if args.json:
+        print(json.dumps(summary, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,205 @@
+"""Typed configuration for every knob the reference hardcodes.
+
+The reference has no config or flag system at all (SURVEY.md §5): hidden sizes
+``[50, 200]`` live at FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:40,
+Adam lr ``0.004`` at :44, StepLR ``(30, 0.5)`` at :46, ``rounds=300`` at :249,
+the grid at hyperparameters_tuning.py:73-74, dataset filenames at
+FL_CustomMLP...:216 / FL_SkLearn...:163. Every one of those literals gets a
+typed, named field here, and the five BASELINE.json configs are shipped as
+named presets.
+
+All config dataclasses are frozen (hashable) so they can be passed as jit
+static arguments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+
+def _candidate_csv_paths() -> Tuple[str, ...]:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return (
+        os.path.join(here, "data", "balanced_income_data.csv"),
+        "/root/reference/balanced_income_data.csv",
+        "balanced_income_data.csv",
+    )
+
+
+def default_income_csv() -> Optional[str]:
+    """Locate the income CSV the reference ships (its only dataset)."""
+    for p in _candidate_csv_paths():
+        if os.path.exists(p):
+            return p
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Host-side data pipeline settings.
+
+    Mirrors the preamble of every reference ``main()``
+    (FL_CustomMLP...:216-246): CSV load -> label-encode object columns ->
+    standard-scale -> train/test split with ``random_state=42``.
+    """
+
+    csv_path: Optional[str] = None       # None => synthetic income-like data
+    label_column: str = "income"         # FL_SkLearn...:164 ('Outcome' for the diabetes path, FL_CustomMLP...:217)
+    test_size: float = 0.2               # FL_CustomMLP...:239
+    split_seed: int = 42                 # random_state=42 everywhere in the reference
+    scale_with_mean: bool = True         # FL_SkLearn...:184 uses with_mean=False; torch driver uses default True
+    # The reference fits the scaler on the FULL dataset before splitting
+    # (FL_CustomMLP...:235-236) — train/test leakage. Parity default keeps it;
+    # set False for the clean fit-on-train-only pipeline.
+    scaler_leakage_parity: bool = True
+    synthetic_rows: int = 2048           # used when csv_path is None (tests / CI)
+    synthetic_features: int = 14         # balanced_income_data.csv has 14 features + label
+    synthetic_classes: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardConfig:
+    """How the (replicated) train set is carved into per-client shards.
+
+    The reference shards contiguously by rank with the last rank taking the
+    remainder (FL_CustomMLP...:48-61). Its shuffle is an UNSEEDED per-rank
+    ``np.random.permutation`` (:53) so client shards overlap instead of
+    partitioning the data — a real behavioral quirk. fedtpu defaults to a
+    shared-seed permutation (a true partition); ``unseeded_per_client_bug``
+    reproduces the reference behavior for bit-parity experiments.
+    """
+
+    num_clients: int = 8
+    shuffle: bool = True
+    shard_seed: int = 0
+    unseeded_per_client_bug: bool = False
+    strategy: str = "contiguous"         # 'contiguous' | 'label_sort' | 'dirichlet'
+    dirichlet_alpha: float = 0.5         # label-skew strength for 'dirichlet'
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Model family + shape. MLP is FL_CustomMLP...:12-25; ConvNet is the
+    BASELINE.json config-5 CIFAR-10 stress model (new, no reference analogue)."""
+
+    kind: str = "mlp"                    # 'mlp' | 'convnet'
+    hidden_sizes: Tuple[int, ...] = (50, 200)  # FL_CustomMLP...:40
+    num_classes: int = 2
+    input_dim: int = 14                  # income CSV feature count
+    image_shape: Tuple[int, int, int] = (32, 32, 3)  # convnet only (HWC)
+    conv_channels: Tuple[int, ...] = (32, 64)
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"       # set 'bfloat16' to run matmuls on the MXU in bf16
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimConfig:
+    """Adam + StepLR exactly as the torch driver configures them
+    (FL_CustomMLP...:44-46): Adam(lr=0.004), StepLR(step_size=30, gamma=0.5),
+    scheduler stepped once per round (:73)."""
+
+    name: str = "adam"                   # 'adam' | 'sgd'
+    learning_rate: float = 0.004
+    b1: float = 0.9                      # torch Adam defaults
+    b2: float = 0.999
+    eps: float = 1e-8
+    steplr_step_size: int = 30
+    steplr_gamma: float = 0.5
+    momentum: float = 0.9                # sgd only
+
+
+@dataclasses.dataclass(frozen=True)
+class FedConfig:
+    """Round orchestration: FedAvg flavor + the early-stopping machinery of
+    FL_CustomMLP...:122-192."""
+
+    rounds: int = 300                    # FL_CustomMLP...:249
+    weighting: str = "data_size"         # 'data_size' (FL_CustomMLP...:112-115) | 'uniform' (hyperparameters_tuning.py:37)
+    termination_patience: int = 10       # FL_CustomMLP...:122
+    tolerance: float = 1e-4              # FL_CustomMLP...:122
+    # Each client starts from an independent random init, matching the
+    # reference where every rank constructs an unseeded torch model
+    # (FL_CustomMLP...:42). Set True to start all clients identical.
+    same_init: bool = False
+    init_seed: int = 0
+    # The reference's stop signal takes effect one round late (:132 vs :195,
+    # SURVEY.md §5 'race detection'). fedtpu stops immediately; no flag to
+    # reproduce the lag — it is a bug, not behavior.
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Host loop I/O: logging, checkpointing, timing, held-out eval."""
+
+    log_every: int = 1
+    log_per_client: bool = False         # parity with the rank-ordered prints (FL_CustomMLP...:151-162)
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0            # 0 = disabled
+    eval_test_every: int = 0             # 0 = disabled; reference never uses its test split (FL_CustomMLP...:243-246)
+    profile_dir: Optional[str] = None    # jax.profiler trace output
+    mesh_devices: int = 0                # 0 = all visible devices
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    data: DataConfig = DataConfig()
+    shard: ShardConfig = ShardConfig()
+    model: ModelConfig = ModelConfig()
+    optim: OptimConfig = OptimConfig()
+    fed: FedConfig = FedConfig()
+    run: RunConfig = RunConfig()
+
+    def replace(self, **kw) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def _income_data() -> DataConfig:
+    return DataConfig(csv_path=default_income_csv(), label_column="income")
+
+
+# The five BASELINE.json configs as named presets (BASELINE.md config matrix).
+PRESETS = {
+    # 1: the reference's own CPU/mpirun baseline shape: 2 clients, 5 rounds.
+    "income-2": ExperimentConfig(
+        data=_income_data(),
+        shard=ShardConfig(num_clients=2),
+        fed=FedConfig(rounds=5),
+    ),
+    # 2: 8-client FedAvg MLP, one client per core on a v4-8 — the north star.
+    "income-8": ExperimentConfig(
+        data=_income_data(),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=300),
+    ),
+    # 3: sklearn MLPClassifier warm-start parity path (FL_SkLearn...),
+    #    hidden (50, 400), uniform averaging, 5 rounds.
+    "sklearn-parity": ExperimentConfig(
+        data=dataclasses.replace(_income_data(), scale_with_mean=False),  # FL_SkLearn...:184
+        shard=ShardConfig(num_clients=4),
+        model=ModelConfig(hidden_sizes=(50, 400)),
+        fed=FedConfig(rounds=5, weighting="uniform"),
+    ),
+    # 4: non-IID label-skewed income shards, 32 clients (v4-32).
+    "income-32-noniid": ExperimentConfig(
+        data=_income_data(),
+        shard=ShardConfig(num_clients=32, strategy="dirichlet", dirichlet_alpha=0.5),
+        fed=FedConfig(rounds=300),
+    ),
+    # 5: CIFAR-10 2-layer ConvNet, 32 clients — pmean payload stress.
+    "cifar10-32": ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=4096,
+                        synthetic_features=32 * 32 * 3, synthetic_classes=10),
+        shard=ShardConfig(num_clients=32),
+        model=ModelConfig(kind="convnet", num_classes=10,
+                          hidden_sizes=(256,), compute_dtype="bfloat16"),
+        fed=FedConfig(rounds=50),
+    ),
+}
+
+
+def get_preset(name: str) -> ExperimentConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; available: {sorted(PRESETS)}")
+    return PRESETS[name]

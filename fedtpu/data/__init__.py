@@ -1,0 +1,6 @@
+from fedtpu.data.tabular import load_tabular_dataset, Dataset  # noqa: F401
+from fedtpu.data.sharding import (  # noqa: F401
+    shard_indices,
+    pack_clients,
+    ClientBatch,
+)

@@ -1,0 +1,3 @@
+from fedtpu.models.mlp import mlp_init, mlp_apply  # noqa: F401
+from fedtpu.models.convnet import convnet_init, convnet_apply  # noqa: F401
+from fedtpu.models.registry import build_model  # noqa: F401

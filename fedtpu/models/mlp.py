@@ -1,0 +1,56 @@
+"""Pure-pytree MLP: the fedtpu analogue of the reference's ``MLPModel``.
+
+The reference model (FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:12-25)
+is a ``Linear -> ReLU`` stack per hidden size with a final ``Linear`` logits
+head, built as ``nn.Sequential``. Here the model is a plain params pytree plus
+a pure ``apply`` function — jit/vmap/grad-transformable with nothing hidden in
+object state, which is what lets a whole federated round compile into one XLA
+program.
+
+Init parity: torch ``nn.Linear`` draws both weight and bias from
+U(-1/sqrt(fan_in), +1/sqrt(fan_in)) (kaiming_uniform with a=sqrt(5) reduces to
+exactly that bound). We reproduce the distribution with JAX PRNG — same law,
+reproducible keys, not bit-identical streams.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def mlp_init(key: jax.Array, input_dim: int, hidden_sizes: Sequence[int],
+             num_classes: int, param_dtype=jnp.float32):
+    """Build the params pytree: ``{'layers': [{'w': (in,out), 'b': (out,)}]}``."""
+    dims = (input_dim, *hidden_sizes, num_classes)
+    layers = []
+    for fan_in, fan_out in zip(dims[:-1], dims[1:]):
+        key, wk, bk = jax.random.split(key, 3)
+        bound = 1.0 / math.sqrt(fan_in)
+        layers.append({
+            "w": jax.random.uniform(wk, (fan_in, fan_out), param_dtype,
+                                    -bound, bound),
+            "b": jax.random.uniform(bk, (fan_out,), param_dtype,
+                                    -bound, bound),
+        })
+    return {"layers": layers}
+
+
+def mlp_apply(params, x: jax.Array, compute_dtype=None) -> jax.Array:
+    """Forward pass -> logits. ``compute_dtype=bfloat16`` runs the matmuls in
+    bf16 on the MXU while keeping params (and the returned logits) in the
+    param dtype — the standard TPU mixed-precision recipe."""
+    layers = params["layers"]
+    out_dtype = layers[0]["w"].dtype
+    h = x if compute_dtype is None else x.astype(compute_dtype)
+    for i, lyr in enumerate(layers):
+        w, b = lyr["w"], lyr["b"]
+        if compute_dtype is not None:
+            w, b = w.astype(compute_dtype), b.astype(compute_dtype)
+        h = h @ w + b
+        if i < len(layers) - 1:
+            h = jax.nn.relu(h)
+    return h.astype(out_dtype)

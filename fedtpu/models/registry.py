@@ -1,0 +1,37 @@
+"""Model registry: ModelConfig -> (init_fn, apply_fn)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+from fedtpu.config import ModelConfig
+from fedtpu.models.mlp import mlp_init, mlp_apply
+from fedtpu.models.convnet import convnet_init, convnet_apply
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+           "float16": jnp.float16}
+
+
+def build_model(cfg: ModelConfig):
+    """Return ``(init_fn(key) -> params, apply_fn(params, x) -> logits)``."""
+    param_dtype = _DTYPES[cfg.param_dtype]
+    compute_dtype = (None if cfg.compute_dtype == cfg.param_dtype
+                     else _DTYPES[cfg.compute_dtype])
+    if cfg.kind == "mlp":
+        init = functools.partial(mlp_init, input_dim=cfg.input_dim,
+                                 hidden_sizes=cfg.hidden_sizes,
+                                 num_classes=cfg.num_classes,
+                                 param_dtype=param_dtype)
+        apply = functools.partial(mlp_apply, compute_dtype=compute_dtype)
+        return init, apply
+    if cfg.kind == "convnet":
+        init = functools.partial(convnet_init, image_shape=cfg.image_shape,
+                                 conv_channels=cfg.conv_channels,
+                                 hidden=cfg.hidden_sizes[0],
+                                 num_classes=cfg.num_classes,
+                                 param_dtype=param_dtype)
+        apply = functools.partial(convnet_apply, compute_dtype=compute_dtype)
+        return init, apply
+    raise ValueError(f"unknown model kind {cfg.kind!r}")

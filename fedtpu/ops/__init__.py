@@ -1,0 +1,7 @@
+from fedtpu.ops.losses import masked_cross_entropy  # noqa: F401
+from fedtpu.ops.metrics import (  # noqa: F401
+    confusion_matrix,
+    metrics_from_confusion,
+    METRIC_NAMES,
+)
+from fedtpu.ops.optim import build_optimizer  # noqa: F401

@@ -1,0 +1,25 @@
+"""Losses.
+
+``masked_cross_entropy`` is the fedtpu analogue of the reference's
+``nn.CrossEntropyLoss()`` applied full-batch
+(FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:43,70): mean softmax
+cross-entropy over the batch. The mask exists because fedtpu pads every client
+shard to a common static length (SURVEY.md §7 'hard parts' / static shapes for
+XLA); padded rows contribute exactly zero to both loss and gradient, so the
+mean is over the true ``len(X_local)`` samples — identical to torch's
+unpadded mean.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_cross_entropy(logits: jax.Array, labels: jax.Array,
+                         mask: jax.Array) -> jax.Array:
+    """Mean CE over rows where mask==1. logits (N,K), labels (N,), mask (N,)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return -(ll * mask).sum() / denom

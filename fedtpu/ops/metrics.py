@@ -1,0 +1,65 @@
+"""In-graph classification metrics with exact sklearn parity.
+
+The reference computes accuracy / weighted precision / recall / F1 with
+sklearn, ``average='weighted', zero_division=0``
+(FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:85-90,
+FL_SkLearn_MLPClassifier_Limitation.py:61-66). Doing that on host would force
+a device->host gather of predictions every round; instead fedtpu reduces each
+client's predictions to a tiny ``(K, K)`` confusion matrix ON DEVICE and
+derives all four metrics from it — algebraically identical to sklearn's
+definitions (tests assert parity against sklearn to 1e-6).
+
+The confusion matrix is also the aggregation currency for the reference's two
+distinct "global metric" semantics (SURVEY.md §5):
+  1. mean of per-client metrics (FL_CustomMLP...:169)  ->  mean over the
+     client axis of per-client metric vectors;
+  2. pooled metrics over concatenated predictions (FL_SkLearn...:132-134) ->
+     metrics of the psum of per-client confusion matrices. Summing confusion
+     matrices IS concatenating predictions, so parity is exact without ever
+     materializing a concatenated prediction vector.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+METRIC_NAMES = ("accuracy", "precision", "recall", "f1")
+
+
+def confusion_matrix(labels: jax.Array, preds: jax.Array, mask: jax.Array,
+                     num_classes: int) -> jax.Array:
+    """(K, K) matrix, rows = true class, cols = predicted class, masked."""
+    idx = labels.astype(jnp.int32) * num_classes + preds.astype(jnp.int32)
+    flat = jnp.zeros((num_classes * num_classes,), jnp.float32)
+    flat = flat.at[idx].add(mask.astype(jnp.float32))
+    return flat.reshape(num_classes, num_classes)
+
+
+def metrics_from_confusion(conf: jax.Array) -> dict:
+    """accuracy + weighted precision/recall/f1 with zero_division=0 semantics.
+
+    weighted metric = sum_c support_c * metric_c / sum_c support_c, where any
+    per-class metric with a zero denominator is 0 — exactly sklearn's
+    ``average='weighted', zero_division=0``.
+    """
+    conf = conf.astype(jnp.float32)
+    total = jnp.maximum(conf.sum(), 1.0)
+    support = conf.sum(axis=1)          # per true class
+    predicted = conf.sum(axis=0)        # per predicted class
+    tp = jnp.diagonal(conf)
+
+    def safe_div(num, den):
+        return jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0)
+
+    prec_c = safe_div(tp, predicted)
+    rec_c = safe_div(tp, support)
+    f1_c = safe_div(2.0 * prec_c * rec_c, prec_c + rec_c)
+
+    wsum = jnp.maximum(support.sum(), 1.0)
+    return {
+        "accuracy": tp.sum() / total,
+        "precision": (support * prec_c).sum() / wsum,
+        "recall": (support * rec_c).sum() / wsum,
+        "f1": (support * f1_c).sum() / wsum,
+    }

@@ -1,0 +1,39 @@
+"""Optimizers with exact torch-driver semantics.
+
+The reference trains each client with ``Adam(lr=0.004)`` under
+``StepLR(step_size=30, gamma=0.5)``, stepping the scheduler once per round
+(FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:44-46,73). Because the
+reference does exactly ONE optimizer step per round (full-batch,
+``train_one_epoch`` :63-73), "scheduler step per round" == "scheduler step per
+update", which maps to a staircase exponential-decay schedule on the update
+count: lr(t) = lr0 * gamma^floor(t / step_size). torch's Adam update is
+``m_hat / (sqrt(v_hat) + eps)`` — optax.adam with ``eps_root=0`` matches
+bit-for-bit in exact arithmetic.
+
+A subtlety the framework preserves (SURVEY.md §7 'hard parts'): FedAvg
+averages PARAMETERS ONLY; each client's Adam moments persist across rounds
+un-averaged (federated_averaging at :101-120 never touches optimizer state).
+The optimizer state pytree therefore keeps a leading clients axis and is
+sharded, never reduced.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from fedtpu.config import OptimConfig
+
+
+def build_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
+    schedule = optax.exponential_decay(
+        init_value=cfg.learning_rate,
+        transition_steps=cfg.steplr_step_size,
+        decay_rate=cfg.steplr_gamma,
+        staircase=True,
+    )
+    if cfg.name == "adam":
+        return optax.adam(learning_rate=schedule, b1=cfg.b1, b2=cfg.b2,
+                          eps=cfg.eps, eps_root=0.0)
+    if cfg.name == "sgd":
+        return optax.sgd(learning_rate=schedule, momentum=cfg.momentum)
+    raise ValueError(f"unknown optimizer {cfg.name!r}")

@@ -1,0 +1,2 @@
+from fedtpu.orchestration.loop import run_experiment, ExperimentResult  # noqa: F401
+from fedtpu.orchestration.checkpoint import save_checkpoint, load_checkpoint  # noqa: F401

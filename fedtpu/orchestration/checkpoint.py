@@ -1,0 +1,84 @@
+"""Round-indexed checkpoint / resume.
+
+The reference has NO persistence at all (SURVEY.md §5): best weights are only
+printed to stdout (hyperparameters_tuning.py:130-132, FL_SkLearn...:146-150)
+and a 300-round run that dies restarts from scratch. fedtpu checkpoints the
+full federated state — per-client params, per-client optimizer state (Adam
+moments are NOT averaged, so they are real per-client state), round counter,
+and metric history — via orbax, and can resume mid-run.
+
+Layout: ``<dir>/round_<step>/{state,meta}`` — two orbax PyTree items. The
+``state`` item is restored against a live state template (``state_like``) so
+optax namedtuple nodes come back as namedtuples, not dicts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from fedtpu.utils.trees import to_numpy
+
+
+def _ckpt_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"round_{step:06d}")
+
+
+def save_checkpoint(directory: str, state, history: dict, step: int) -> str:
+    """Write state + {history, step} under ``directory/round_<step>``."""
+    path = _ckpt_path(directory, step)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(os.path.join(path, "state"), to_numpy(state), force=True)
+    ckptr.save(os.path.join(path, "meta"),
+               {"history": {k: np.asarray(v) for k, v in history.items()},
+                "step": np.asarray(step)},
+               force=True)
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("round_"):
+            try:
+                steps.append(int(name.split("_")[1]))
+            except (IndexError, ValueError):
+                continue
+    return max(steps) if steps else None
+
+
+def load_checkpoint(directory: str, step: Optional[int] = None,
+                    sharding=None, state_like=None) -> Tuple[dict, dict, int]:
+    """Read back ``(state, history, step)``.
+
+    ``state_like``: a live state pytree (e.g. a freshly-initialized one from
+    ``init_federated_state``) used as the restore template so container types
+    (optax namedtuples) survive the roundtrip. ``sharding``: re-lay-out the
+    client-axis leaves onto the mesh.
+    """
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = _ckpt_path(directory, step)
+    ckptr = ocp.PyTreeCheckpointer()
+    template = to_numpy(state_like) if state_like is not None else None
+    state = ckptr.restore(os.path.join(path, "state"), item=template)
+    meta = ckptr.restore(os.path.join(path, "meta"))
+    if sharding is not None:
+        # Every non-scalar state leaf carries the leading clients axis
+        # (params, Adam moments); scalars (the round counter, Adam counts of
+        # shape (C,) stay client-sharded too since ndim >= 1).
+        state = jax.tree.map(
+            lambda l: (jax.device_put(l, sharding)
+                       if getattr(l, "ndim", 0) >= 1 else jax.device_put(l)),
+            state)
+    history = {k: list(np.asarray(v))
+               for k, v in meta["history"].items()}
+    return state, history, int(np.asarray(meta["step"]))

@@ -1,0 +1,219 @@
+"""Host round loop: the fedtpu analogue of ``train_and_evaluate``
+(FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:122-207).
+
+What the reference round loop does with ~5 collectives, 2N+3 barriers, and
+pickled weight dicts per round, this loop does with ONE call into the compiled
+round program (fedtpu.parallel.round) and a scalar metrics read-back. The
+host's only jobs are: decide early stopping, accumulate history, log,
+checkpoint, and time.
+
+Early-stopping parity (:181-192): rank 0 compares the 4-metric vector
+(accuracy, precision, recall, f1 — mean over clients) against the previous
+round with ``np.allclose(atol=tolerance)``; `patience` consecutive unchanged
+rounds stop training. The reference's stop signal takes effect one round late
+because the loop-top bcast at :132 reads the PREVIOUS round's signal (:195,
+SURVEY.md §5) — fedtpu stops immediately (the lag is a bug, not semantics).
+
+The metric accumulated for stopping is the reference's semantics #1 — the
+MEAN of per-client train-shard metrics (:169). The pooled semantics
+(FL_SkLearn...:132-134) and the held-out test metrics (NEW — the reference
+broadcasts a test split it never touches, :243-246) are recorded alongside.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from fedtpu.config import ExperimentConfig
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import load_tabular_dataset, Dataset
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.ops.metrics import METRIC_NAMES
+from fedtpu.parallel.mesh import make_mesh, client_sharding
+from fedtpu.parallel.round import (build_round_fn, build_eval_fn,
+                                   init_federated_state, global_params)
+from fedtpu.utils.timing import Timer
+from fedtpu.utils.trees import to_numpy
+
+
+@dataclasses.dataclass
+class ExperimentResult:
+    """History + final model, the superset of the reference's
+    ``global_metrics`` return dict (FL_CustomMLP...:124,207)."""
+
+    # semantics #1: mean of per-client train-shard metrics, one list per
+    # metric name — shape-compatible with the reference's global_metrics.
+    global_metrics: Dict[str, List[float]]
+    # semantics #2: pooled-over-all-clients metrics per round.
+    pooled_metrics: Dict[str, List[float]]
+    # per-client metric trajectories: (rounds, clients) per name.
+    per_client_metrics: Dict[str, List[np.ndarray]]
+    # held-out test metrics of the averaged global model (NEW).
+    test_metrics: Dict[str, List[float]]
+    loss: List[np.ndarray]
+    sec_per_round: List[float]
+    rounds_run: int
+    stopped_early: bool
+    final_params: dict
+    config: ExperimentConfig
+
+    def summary(self) -> dict:
+        last = {k: v[-1] for k, v in self.global_metrics.items() if v}
+        return {
+            "rounds_run": self.rounds_run,
+            "stopped_early": self.stopped_early,
+            "final_global_metrics": last,
+            "mean_sec_per_round": (float(np.mean(self.sec_per_round[1:]))
+                                   if len(self.sec_per_round) > 1
+                                   else float(np.mean(self.sec_per_round or [0.0]))),
+        }
+
+
+def build_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None):
+    """Wire data -> mesh -> model -> optimizer -> compiled round. Returns
+    (round_step, state, batch, eval_step, dataset, mesh)."""
+    ds = dataset or load_tabular_dataset(cfg.data)
+    model_cfg = cfg.model
+    if model_cfg.kind == "mlp" and model_cfg.input_dim != ds.input_dim:
+        model_cfg = dataclasses.replace(model_cfg, input_dim=ds.input_dim)
+    if model_cfg.num_classes != ds.num_classes:
+        model_cfg = dataclasses.replace(model_cfg, num_classes=ds.num_classes)
+
+    mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
+    init_fn, apply_fn = build_model(model_cfg)
+    tx = build_optimizer(cfg.optim)
+
+    packed = pack_clients(ds.x_train, ds.y_train, cfg.shard)
+    shard = client_sharding(mesh)
+    batch = {
+        "x": jax.device_put(packed.x, shard),
+        "y": jax.device_put(packed.y, shard),
+        "mask": jax.device_put(packed.mask, shard),
+    }
+
+    state = init_federated_state(
+        jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
+        init_fn, tx, same_init=cfg.fed.same_init)
+    round_step = build_round_fn(mesh, apply_fn, tx, ds.num_classes,
+                                weighting=cfg.fed.weighting)
+    eval_step = build_eval_fn(apply_fn, ds.num_classes)
+    return round_step, state, batch, eval_step, ds, mesh
+
+
+def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
+                   verbose: bool = True,
+                   resume: bool = False) -> ExperimentResult:
+    """``resume=True``: restore the latest checkpoint under
+    ``cfg.run.checkpoint_dir`` (full per-client state + the client-mean metric
+    history) and continue the round loop from the saved round. Pooled /
+    per-client / test histories restart at the resume point; the early-stop
+    comparator re-seeds from the restored history's last entry."""
+    round_step, state, batch, eval_step, ds, mesh = build_experiment(cfg, dataset)
+
+    start_round = 0
+    restored_history = None
+    if resume and cfg.run.checkpoint_dir:
+        from fedtpu.orchestration.checkpoint import latest_step, load_checkpoint
+        if latest_step(cfg.run.checkpoint_dir) is not None:
+            state, restored_history, start_round = load_checkpoint(
+                cfg.run.checkpoint_dir, sharding=client_sharding(mesh),
+                state_like=state)
+            if verbose:
+                print(f"Resumed from checkpoint at round {start_round}.",
+                      flush=True)
+
+    history = {k: [] for k in METRIC_NAMES}
+    pooled_hist = {k: [] for k in METRIC_NAMES}
+    per_client_hist = {k: [] for k in METRIC_NAMES}
+    test_hist = {k: [] for k in METRIC_NAMES}
+    losses: List[np.ndarray] = []
+    timer = Timer().start()
+
+    prev_metric = None
+    termination_count = cfg.fed.termination_patience
+    stopped_early = False
+    rounds_run = 0
+
+    if restored_history is not None:
+        for k in METRIC_NAMES:
+            history[k] = list(restored_history.get(k, []))
+        if history[METRIC_NAMES[0]]:
+            prev_metric = [history[k][-1] for k in METRIC_NAMES]
+        rounds_run = start_round
+
+    ckpt_every = cfg.run.checkpoint_every
+    if ckpt_every and cfg.run.checkpoint_dir:
+        from fedtpu.orchestration.checkpoint import save_checkpoint
+
+    for rnd in range(start_round, cfg.fed.rounds):
+        state, metrics = round_step(state, batch)
+
+        client_mean = {k: float(v) for k, v in metrics["client_mean"].items()}
+        pooled = {k: float(v) for k, v in metrics["pooled"].items()}
+        per_client = {k: np.asarray(v) for k, v in metrics["per_client"].items()}
+        losses.append(np.asarray(metrics["loss"]))
+        dt = timer.lap()
+        rounds_run = rnd + 1
+
+        for k in METRIC_NAMES:
+            history[k].append(client_mean[k])
+            pooled_hist[k].append(pooled[k])
+            per_client_hist[k].append(per_client[k])
+
+        if cfg.run.eval_test_every and (rnd + 1) % cfg.run.eval_test_every == 0:
+            tm = eval_step(global_params(state), ds.x_test, ds.y_test)
+            for k in METRIC_NAMES:
+                test_hist[k].append(float(tm[k]))
+
+        if verbose and (rnd % cfg.run.log_every == 0):
+            print(f"\nRound {rnd + 1}:\n", flush=True)
+            if cfg.run.log_per_client:
+                # Parity with the barrier-serialized rank-ordered prints
+                # (FL_CustomMLP...:151-162) — here just a loop, no barriers.
+                for c in range(cfg.shard.num_clients):
+                    vals = ", ".join(f"{k}: {per_client[k][c]:.4f}"
+                                     for k in METRIC_NAMES)
+                    print(f"  CLIENT {c} - Local Metrics (Round {rnd + 1}): "
+                          f"[{vals}]", flush=True)
+            gvals = ", ".join(f"{k}: {client_mean[k]:.4f}"
+                              for k in METRIC_NAMES)
+            print(f"  Global Metrics (Round {rnd + 1}): [{gvals}]  "
+                  f"({dt * 1e3:.1f} ms)", flush=True)
+
+        if ckpt_every and cfg.run.checkpoint_dir and \
+                (rnd + 1) % ckpt_every == 0:
+            save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd + 1)
+
+        # Early stopping — exact reference logic (FL_CustomMLP...:181-192).
+        cur = [client_mean[k] for k in METRIC_NAMES]
+        if prev_metric is not None and np.allclose(
+                cur, prev_metric, atol=cfg.fed.tolerance):
+            termination_count -= 1
+            if termination_count == 0:
+                if verbose:
+                    print("Early stopping triggered: No significant change in "
+                          f"metrics for {cfg.fed.termination_patience} rounds.",
+                          flush=True)
+                stopped_early = True
+                break
+        else:
+            prev_metric = cur
+            termination_count = cfg.fed.termination_patience
+
+    return ExperimentResult(
+        global_metrics=history,
+        pooled_metrics=pooled_hist,
+        per_client_metrics=per_client_hist,
+        test_metrics=test_hist,
+        loss=losses,
+        sec_per_round=list(timer.laps),
+        rounds_run=rounds_run,
+        stopped_early=stopped_early,
+        final_params=to_numpy(global_params(state)),
+        config=cfg,
+    )

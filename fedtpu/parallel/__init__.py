@@ -1,0 +1,2 @@
+from fedtpu.parallel.mesh import make_mesh, client_sharding, CLIENTS_AXIS  # noqa: F401
+from fedtpu.parallel.round import build_round_fn, init_federated_state  # noqa: F401

@@ -1,0 +1,51 @@
+"""Device mesh helpers — the fedtpu replacement for ``MPI.COMM_WORLD``.
+
+The reference gets its process topology from
+``MPI.COMM_WORLD.Get_rank()/Get_size()``
+(FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:212-214): N OS
+processes, one per federated client, glued together by pickled collectives.
+fedtpu is single-controller JAX: topology is a ``jax.sharding.Mesh`` with a
+``('clients',)`` axis laid over the TPU cores (ICI within a host; add
+``jax.distributed.initialize`` and the same mesh spans hosts over DCN).
+Client identity inside a compiled program is ``jax.lax.axis_index('clients')``
+— the in-graph analogue of ``Get_rank()``.
+
+The number of federated clients C need not equal the number of devices D:
+clients are block-distributed C/D per device (C % D == 0), and per-device
+blocks are vmapped — the same way ``mpirun -np 8`` oversubscribes one CPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENTS_AXIS = "clients"
+
+
+def make_mesh(num_devices: int = 0, num_clients: int = 0) -> Mesh:
+    """Build a 1-D ('clients',) mesh.
+
+    num_devices=0 uses every visible device; if ``num_clients`` is given, the
+    device count is trimmed to the largest divisor of num_clients so the
+    client axis block-distributes evenly.
+    """
+    devices = jax.devices()
+    n = num_devices or len(devices)
+    n = min(n, len(devices))
+    if num_clients:
+        while num_clients % n:
+            n -= 1
+    return Mesh(np.asarray(devices[:n]), (CLIENTS_AXIS,))
+
+
+def client_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding that splits an array's leading (clients) axis over the
+    mesh — how client shards, per-client params, and per-client optimizer
+    state are all laid out."""
+    return NamedSharding(mesh, P(CLIENTS_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

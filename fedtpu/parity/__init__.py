@@ -1,0 +1,1 @@
+from fedtpu.parity.sklearn_warmstart import run_parity_demo  # noqa: F401

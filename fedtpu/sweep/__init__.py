@@ -1,0 +1,1 @@
+from fedtpu.sweep.grid import run_grid_search, HIDDEN_GRID, LR_GRID  # noqa: F401

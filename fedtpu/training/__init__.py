@@ -1,0 +1,1 @@
+from fedtpu.training.client import make_local_train_step, make_local_eval_step  # noqa: F401

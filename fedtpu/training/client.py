@@ -1,0 +1,56 @@
+"""Per-client local training and evaluation as pure functions.
+
+These are the fedtpu analogues of the reference client methods:
+
+* ``make_local_train_step`` == ``train_one_epoch``
+  (FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:63-73): ONE
+  full-batch forward/backward/optimizer step on the client's whole shard per
+  round — no minibatching, no DataLoader — followed by the LR-schedule step
+  (folded into the optax schedule, see fedtpu.ops.optim).
+* ``make_local_eval_step`` == ``evaluate_local`` (:75-91): argmax predictions
+  on the client's own training shard (the reference never evaluates held-out
+  data in the round loop), reduced to a confusion matrix on device instead of
+  shipping predictions to host sklearn.
+
+Being pure functions of ``(params, opt_state, batch)``, they vmap over the
+per-device client block inside the shard_map round and jit anywhere on their
+own (single-client training is the num_clients=1 special case, no separate
+code path).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedtpu.ops.losses import masked_cross_entropy
+from fedtpu.ops.metrics import confusion_matrix
+
+
+def make_local_train_step(apply_fn: Callable,
+                          tx: optax.GradientTransformation) -> Callable:
+    """Returns ``step(params, opt_state, x, y, mask) ->
+    (params, opt_state, loss)`` — one full-batch update."""
+
+    def step(params, opt_state, x, y, mask):
+        def loss_fn(p):
+            return masked_cross_entropy(apply_fn(p, x), y, mask)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def make_local_eval_step(apply_fn: Callable, num_classes: int) -> Callable:
+    """Returns ``eval(params, x, y, mask) -> (K, K) confusion matrix``."""
+
+    def step(params, x, y, mask):
+        preds = jnp.argmax(apply_fn(params, x), axis=-1)
+        return confusion_matrix(y, preds, mask, num_classes)
+
+    return step

@@ -1,0 +1,2 @@
+from fedtpu.utils.trees import param_count, tree_bytes  # noqa: F401
+from fedtpu.utils.timing import Timer  # noqa: F401

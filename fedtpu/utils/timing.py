@@ -1,0 +1,33 @@
+"""Wall-clock timing — the observability the reference lacks entirely
+(SURVEY.md §5: no timers, no profiler; ``print(flush=True)`` only)."""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    """Accumulates per-lap wall-clock times (seconds)."""
+
+    def __init__(self):
+        self.laps = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def lap(self) -> float:
+        now = time.perf_counter()
+        dt = now - self._t0
+        self._t0 = now
+        self.laps.append(dt)
+        return dt
+
+    @property
+    def total(self) -> float:
+        return sum(self.laps)
+
+    def mean(self, skip_first: int = 0) -> float:
+        laps = self.laps[skip_first:] or self.laps
+        return sum(laps) / max(len(laps), 1)

@@ -1,0 +1,20 @@
+"""Small pytree utilities."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def param_count(tree) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree))
+
+
+def tree_bytes(tree) -> int:
+    return sum(int(np.prod(l.shape)) * l.dtype.itemsize
+               for l in jax.tree.leaves(tree))
+
+
+def to_numpy(tree):
+    """Device -> host copy of a whole pytree."""
+    return jax.tree.map(lambda l: np.asarray(jax.device_get(l)), tree)

@@ -1,0 +1,26 @@
+"""Test harness: run every test on a virtual 8-device CPU mesh.
+
+This is the standard JAX fake-backend trick (SURVEY.md §4): force the host
+platform to expose 8 devices so multi-client mesh code runs (and collectives
+execute) without TPU hardware. Must be set before jax initializes.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Some environments pre-register an accelerator PJRT plugin at interpreter
+# start and force jax_platforms to it; re-force CPU before any backend is
+# initialized so the 8 virtual devices take effect.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}")

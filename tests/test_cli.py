@@ -1,0 +1,23 @@
+"""CLI layer (the reference has none — SURVEY.md §1 L6)."""
+
+import json
+
+from fedtpu.cli import main
+
+
+def test_presets_listing(capsys):
+    assert main(["presets"]) == 0
+    out = capsys.readouterr().out
+    for name in ("income-2", "income-8", "sklearn-parity", "income-32-noniid",
+                 "cifar10-32"):
+        assert name in out
+
+
+def test_run_with_overrides_json(capsys):
+    rc = main(["run", "--preset", "income-8", "--csv", "", "--rounds", "3",
+               "--num-clients", "4", "--quiet", "--json"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(line)
+    assert summary["rounds_run"] == 3
+    assert "accuracy" in summary["final_global_metrics"]

@@ -1,0 +1,125 @@
+"""FedAvg correctness against a numpy oracle implementing the reference's
+weighted average verbatim (FL_CustomMLP...:108-116), plus the
+optimizer-state-not-averaged invariant (SURVEY.md §7 'hard parts')."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.parallel.round import build_round_fn, init_federated_state
+
+
+def _setup(num_clients=8, rows=200, lr=0.004, weighting="data_size",
+           same_init=False):
+    x, y = synthetic_income_like(rows, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=num_clients,
+                                            shuffle=False))
+    mesh = make_mesh(num_clients=num_clients)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig(learning_rate=lr))
+    state = init_federated_state(jax.random.key(1), mesh, num_clients,
+                                 init_fn, tx, same_init=same_init)
+    shard = client_sharding(mesh)
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    round_step = build_round_fn(mesh, apply_fn, tx, 2, weighting=weighting)
+    return state, batch, round_step, packed
+
+
+def _oracle_weighted_average(per_client_weights, sizes):
+    """Verbatim numpy transcription of FL_CustomMLP...:110-115 semantics."""
+    total = sum(sizes)
+    return sum(w * (n / total) for w, n in zip(per_client_weights, sizes))
+
+
+def test_weighted_average_matches_numpy_oracle():
+    # lr=0 turns the train step into identity, isolating the averaging.
+    state, batch, round_step, packed = _setup(lr=0.0)
+    before = np.asarray(state["params"]["layers"][0]["w"])  # (C, in, out)
+    new_state, _ = round_step(state, batch)
+    after = np.asarray(new_state["params"]["layers"][0]["w"])
+    expected = _oracle_weighted_average(list(before),
+                                        list(packed.counts.astype(float)))
+    for c in range(8):
+        np.testing.assert_allclose(after[c], expected, atol=1e-6)
+
+
+def test_uniform_average_matches_plain_mean():
+    state, batch, round_step, _ = _setup(lr=0.0, weighting="uniform")
+    before = np.asarray(state["params"]["layers"][1]["b"])
+    new_state, _ = round_step(state, batch)
+    after = np.asarray(new_state["params"]["layers"][1]["b"])
+    np.testing.assert_allclose(after[0], before.mean(axis=0), atol=1e-6)
+
+
+def test_unequal_shards_weight_by_true_counts():
+    # 103 rows over 8 clients: counts [12]*7+[19]; padding must not leak into
+    # the weights (weight == mask sum == len(X_local), FL_CustomMLP...:104).
+    state, batch, round_step, packed = _setup(rows=103, lr=0.0)
+    assert packed.counts.tolist() == [12] * 7 + [19]
+    before = np.asarray(state["params"]["layers"][0]["w"])
+    new_state, _ = round_step(state, batch)
+    after = np.asarray(new_state["params"]["layers"][0]["w"])
+    expected = _oracle_weighted_average(list(before),
+                                        [12.0] * 7 + [19.0])
+    np.testing.assert_allclose(after[0], expected, atol=1e-6)
+
+
+def test_optimizer_state_is_not_averaged():
+    # The reference averages parameters ONLY (:101-120); Adam moments stay
+    # per-client. With different shards, clients' moments must diverge.
+    state, batch, round_step, _ = _setup(lr=0.004)
+    new_state, _ = round_step(state, batch)
+    mu = np.asarray(jax.tree.leaves(new_state["opt_state"])[1])  # some moment
+    assert mu.shape[0] == 8
+    assert not np.allclose(mu[0], mu[1])
+
+
+def test_identical_data_same_init_equals_single_client():
+    # N clients with identical shards and identical init must follow exactly
+    # the single-client trajectory (averaging identical params is identity).
+    x, y = synthetic_income_like(128, 6, 2)
+    mesh = make_mesh(num_clients=8)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig())
+
+    # 8 clients, every one holding the SAME 128 rows.
+    xc = np.broadcast_to(x, (8, *x.shape)).copy()
+    yc = np.broadcast_to(y, (8, *y.shape)).copy()
+    mask = np.ones((8, len(y)), np.float32)
+    shard = client_sharding(mesh)
+    batch = {"x": jax.device_put(xc, shard), "y": jax.device_put(yc, shard),
+             "mask": jax.device_put(mask, shard)}
+    state = init_federated_state(jax.random.key(5), mesh, 8, init_fn, tx,
+                                 same_init=True)
+    round_step = build_round_fn(mesh, apply_fn, tx, 2)
+    for _ in range(3):
+        state, metrics = round_step(state, batch)
+
+    # Single-client run (mesh of 1 device slice).
+    mesh1 = make_mesh(num_devices=1, num_clients=1)
+    state1 = init_federated_state(jax.random.key(5), mesh1, 1, init_fn, tx,
+                                  same_init=True)
+    shard1 = client_sharding(mesh1)
+    batch1 = {"x": jax.device_put(xc[:1], shard1),
+              "y": jax.device_put(yc[:1], shard1),
+              "mask": jax.device_put(mask[:1], shard1)}
+    round1 = build_round_fn(mesh1, apply_fn, tx, 2)
+    for _ in range(3):
+        state1, metrics1 = round1(state1, batch1)
+
+    np.testing.assert_allclose(
+        np.asarray(state["params"]["layers"][0]["w"])[0],
+        np.asarray(state1["params"]["layers"][0]["w"])[0],
+        atol=1e-5)
+    np.testing.assert_allclose(float(metrics["client_mean"]["accuracy"]),
+                               float(metrics1["client_mean"]["accuracy"]),
+                               atol=1e-6)

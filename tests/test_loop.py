@@ -1,0 +1,52 @@
+"""End-to-end orchestration loop: history shapes, early stopping semantics
+(FL_CustomMLP...:181-192), held-out eval."""
+
+import numpy as np
+
+from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig, RunConfig,
+                           ShardConfig)
+from fedtpu.orchestration.loop import run_experiment
+
+
+def _cfg(**fed_kw):
+    return ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=512),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=fed_kw.pop("rounds", 10), **fed_kw),
+        run=RunConfig(eval_test_every=1),
+    )
+
+
+def test_run_experiment_history_shapes():
+    res = run_experiment(_cfg(rounds=5), verbose=False)
+    assert res.rounds_run == 5
+    for k in ("accuracy", "precision", "recall", "f1"):
+        assert len(res.global_metrics[k]) == 5
+        assert len(res.pooled_metrics[k]) == 5
+        assert len(res.test_metrics[k]) == 5
+        assert res.per_client_metrics[k][0].shape == (8,)
+    assert len(res.sec_per_round) == 5
+    assert res.final_params["layers"][0]["w"].ndim == 2  # global, no client axis
+
+
+def test_training_improves_metrics():
+    res = run_experiment(_cfg(rounds=25), verbose=False)
+    acc = res.global_metrics["accuracy"]
+    assert acc[-1] > acc[0]
+    assert acc[-1] > 0.8  # separable synthetic data
+
+
+def test_early_stopping_with_huge_tolerance():
+    # atol=1.0 makes every round "unchanged": patience must fire exactly.
+    res = run_experiment(_cfg(rounds=50, termination_patience=3,
+                              tolerance=1.0), verbose=False)
+    assert res.stopped_early
+    # Round 1 sets prev; rounds 2,3,4 count down 3->0 => stop at round 4.
+    assert res.rounds_run == 4
+
+
+def test_no_early_stop_when_metrics_move():
+    res = run_experiment(_cfg(rounds=8, termination_patience=10,
+                              tolerance=1e-12), verbose=False)
+    assert not res.stopped_early
+    assert res.rounds_run == 8

@@ -1,0 +1,72 @@
+"""In-graph metric parity against sklearn (the reference's metric source:
+FL_CustomMLP...:85-90 — accuracy + weighted precision/recall/F1,
+zero_division=0)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import (accuracy_score, precision_score, recall_score,
+                             f1_score)
+
+from fedtpu.ops.metrics import confusion_matrix, metrics_from_confusion
+
+
+def _sklearn_reference(y, p):
+    return {
+        "accuracy": accuracy_score(y, p),
+        "precision": precision_score(y, p, average="weighted",
+                                     zero_division=0),
+        "recall": recall_score(y, p, average="weighted", zero_division=0),
+        "f1": f1_score(y, p, average="weighted", zero_division=0),
+    }
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("num_classes", [2, 5])
+def test_metrics_match_sklearn(seed, num_classes):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, num_classes, size=500).astype(np.int32)
+    p = rng.integers(0, num_classes, size=500).astype(np.int32)
+    mask = np.ones(500, np.float32)
+    ours = metrics_from_confusion(confusion_matrix(y, p, mask, num_classes))
+    ref = _sklearn_reference(y, p)
+    for k, v in ref.items():
+        np.testing.assert_allclose(float(ours[k]), v, atol=1e-6, err_msg=k)
+
+
+def test_zero_division_semantics():
+    # Class 2 never predicted and class 3 never true: both per-class terms
+    # must be 0, not NaN (zero_division=0).
+    y = np.array([0, 0, 1, 1, 2, 2], np.int32)
+    p = np.array([0, 1, 1, 0, 3, 3], np.int32)
+    mask = np.ones(6, np.float32)
+    ours = metrics_from_confusion(confusion_matrix(y, p, mask, 4))
+    ref = _sklearn_reference(y, p)
+    for k, v in ref.items():
+        assert np.isfinite(float(ours[k]))
+        np.testing.assert_allclose(float(ours[k]), v, atol=1e-6, err_msg=k)
+
+
+def test_mask_excludes_padding():
+    y = np.array([0, 1, 0, 1], np.int32)
+    p = np.array([0, 1, 1, 0], np.int32)  # last two rows are "padding"
+    mask = np.array([1, 1, 0, 0], np.float32)
+    ours = metrics_from_confusion(confusion_matrix(y, p, mask, 2))
+    assert float(ours["accuracy"]) == 1.0
+
+
+def test_summed_confusions_equal_concatenated_predictions():
+    # Pooled-metric semantics #2 (FL_SkLearn...:132-134): metrics over
+    # concatenated predictions == metrics of the SUM of confusion matrices.
+    rng = np.random.default_rng(9)
+    confs, ys, ps = [], [], []
+    for _ in range(4):
+        y = rng.integers(0, 3, size=100).astype(np.int32)
+        p = rng.integers(0, 3, size=100).astype(np.int32)
+        confs.append(np.asarray(confusion_matrix(
+            y, p, np.ones(100, np.float32), 3)))
+        ys.append(y)
+        ps.append(p)
+    pooled = metrics_from_confusion(np.sum(confs, axis=0))
+    ref = _sklearn_reference(np.concatenate(ys), np.concatenate(ps))
+    for k, v in ref.items():
+        np.testing.assert_allclose(float(pooled[k]), v, atol=1e-6, err_msg=k)

@@ -1,0 +1,60 @@
+"""Optimizer parity against the torch driver's exact configuration:
+Adam(lr=0.004) + StepLR(step_size=30, gamma=0.5), one scheduler step per
+update (FL_CustomMLP...:44-46,73)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import torch
+
+from fedtpu.config import OptimConfig
+from fedtpu.ops.optim import build_optimizer
+
+
+def test_adam_steplr_matches_torch_trajectory():
+    # Quadratic bowl: loss = 0.5 * ||p - t||^2, grad = p - t. 70 steps crosses
+    # the StepLR boundary at step 30 (lr 0.004 -> 0.002) and at 60 (-> 0.001).
+    rng = np.random.default_rng(0)
+    p0 = rng.normal(size=(16,)).astype(np.float32)
+    target = rng.normal(size=(16,)).astype(np.float32)
+
+    # --- torch reference
+    p_t = torch.nn.Parameter(torch.tensor(p0.copy()))
+    opt = torch.optim.Adam([p_t], lr=0.004)
+    sched = torch.optim.lr_scheduler.StepLR(opt, step_size=30, gamma=0.5)
+    t_target = torch.tensor(target)
+    torch_traj = []
+    for _ in range(70):
+        opt.zero_grad()
+        loss = 0.5 * ((p_t - t_target) ** 2).sum()
+        loss.backward()
+        opt.step()
+        sched.step()
+        torch_traj.append(p_t.detach().numpy().copy())
+
+    # --- fedtpu
+    tx = build_optimizer(OptimConfig())
+    p_j = jnp.asarray(p0)
+    state = tx.init(p_j)
+
+    @jax.jit
+    def step(p, s):
+        grads = p - jnp.asarray(target)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s
+
+    for i in range(70):
+        p_j, state = step(p_j, state)
+        np.testing.assert_allclose(np.asarray(p_j), torch_traj[i], atol=2e-5,
+                                   err_msg=f"diverged at step {i}")
+
+
+def test_schedule_staircase_boundaries():
+    tx = build_optimizer(OptimConfig(learning_rate=0.004,
+                                     steplr_step_size=30, steplr_gamma=0.5))
+    sched = optax.exponential_decay(0.004, 30, 0.5, staircase=True)
+    np.testing.assert_allclose(float(sched(0)), 0.004, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(29)), 0.004, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(30)), 0.002, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(60)), 0.001, rtol=1e-6)

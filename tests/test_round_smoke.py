@@ -1,0 +1,50 @@
+"""Smoke test: one compiled federated round on 8 virtual devices."""
+
+import jax
+import numpy as np
+
+from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.parallel.round import (build_round_fn, init_federated_state,
+                                   global_params, build_eval_fn)
+
+
+def test_round_runs_on_8_device_mesh():
+    assert len(jax.devices()) == 8
+    x, y = synthetic_income_like(512, 14, 2)
+    batch_np = pack_clients(x, y, ShardConfig(num_clients=8))
+
+    mesh = make_mesh(num_clients=8)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=14))
+    tx = build_optimizer(OptimConfig())
+    state = init_federated_state(jax.random.key(0), mesh, 8, init_fn, tx)
+
+    shard = client_sharding(mesh)
+    batch = {
+        "x": jax.device_put(batch_np.x, shard),
+        "y": jax.device_put(batch_np.y, shard),
+        "mask": jax.device_put(batch_np.mask, shard),
+    }
+    round_step = build_round_fn(mesh, apply_fn, tx, num_classes=2)
+
+    state, metrics = round_step(state, batch)
+    assert metrics["loss"].shape == (8,)
+    assert float(metrics["client_mean"]["accuracy"]) >= 0.0
+
+    # After averaging, every client slot must hold the identical global model.
+    p = np.asarray(state["params"]["layers"][0]["w"])
+    for c in range(1, 8):
+        np.testing.assert_allclose(p[c], p[0], rtol=0, atol=0)
+
+    # A few more rounds should drive accuracy up on separable synthetic data.
+    for _ in range(20):
+        state, metrics = round_step(state, batch)
+    assert float(metrics["client_mean"]["accuracy"]) > 0.8
+
+    ev = build_eval_fn(apply_fn, 2)
+    m = ev(global_params(state), batch["x"][0], batch["y"][0])
+    assert 0.0 <= float(m["accuracy"]) <= 1.0

@@ -1,0 +1,44 @@
+"""Federated grid search (hyperparameters_tuning.py analogue): the vmapped
+learning-rate axis must agree with the sequential path."""
+
+import numpy as np
+
+from fedtpu.config import DataConfig, ExperimentConfig, ShardConfig
+from fedtpu.data.tabular import load_tabular_dataset
+from fedtpu.sweep.grid import run_grid_search
+
+
+def _cfg():
+    return ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256),
+        shard=ShardConfig(num_clients=8),
+    )
+
+
+def test_vmap_and_sequential_paths_agree():
+    cfg = _cfg()
+    ds = load_tabular_dataset(cfg.data)
+    hidden = ((8,), (4, 4))
+    lrs = (0.01, 0.05)
+    kw = dict(dataset=ds, hidden_grid=hidden, lr_grid=lrs, local_steps=20,
+              verbose=False)
+    res_v = run_grid_search(cfg, vmap_lr=True, **kw)
+    res_s = run_grid_search(cfg, vmap_lr=False, **kw)
+
+    assert len(res_v["table"]) == len(res_s["table"]) == 4
+    tv = {(r["hidden_layer_sizes"], r["learning_rate"]): r["accuracy"]
+          for r in res_v["table"]}
+    ts = {(r["hidden_layer_sizes"], r["learning_rate"]): r["accuracy"]
+          for r in res_s["table"]}
+    for k in tv:
+        np.testing.assert_allclose(tv[k], ts[k], atol=1e-5)
+    assert res_v["params"] == res_s["params"]
+
+
+def test_best_config_is_tracked():
+    cfg = _cfg()
+    res = run_grid_search(cfg, hidden_grid=((8,),), lr_grid=(0.01, 0.2),
+                          local_steps=30, verbose=False)
+    assert res["accuracy"] == max(r["accuracy"] for r in res["table"])
+    assert set(res["params"]) == {"hidden_layer_sizes", "learning_rate"}
+    assert res["weight_shapes"]  # averaged global weights were captured
