@@ -49,6 +49,8 @@ def _add_common_overrides(p: argparse.ArgumentParser):
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=None)
     p.add_argument("--eval-test-every", type=int, default=None)
+    p.add_argument("--rounds-per-step", type=int, default=None,
+                   help="rounds scanned per compiled step (throughput knob)")
     p.add_argument("--log-per-client", action="store_true")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--json", action="store_true",
@@ -84,6 +86,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["checkpoint_every"] = args.checkpoint_every
     if args.eval_test_every is not None:
         run_kw["eval_test_every"] = args.eval_test_every
+    if args.rounds_per_step is not None:
+        run_kw["rounds_per_step"] = args.rounds_per_step
     if args.log_per_client:
         run_kw["log_per_client"] = True
     if run_kw:

@@ -135,6 +135,10 @@ class RunConfig:
 
     log_every: int = 1
     log_per_client: bool = False         # parity with the rank-ordered prints (FL_CustomMLP...:151-162)
+    # Rounds scanned inside one compiled program (host syncs once per chunk).
+    # 1 == exact reference cadence; raise for throughput when the host<->device
+    # round-trip dominates (early stop may overshoot by up to R-1 rounds).
+    rounds_per_step: int = 1
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0            # 0 = disabled
     eval_test_every: int = 0             # 0 = disabled; reference never uses its test split (FL_CustomMLP...:243-246)

@@ -3,9 +3,9 @@
 
 What the reference round loop does with ~5 collectives, 2N+3 barriers, and
 pickled weight dicts per round, this loop does with ONE call into the compiled
-round program (fedtpu.parallel.round) and a scalar metrics read-back. The
-host's only jobs are: decide early stopping, accumulate history, log,
-checkpoint, and time.
+round program (fedtpu.parallel.round) per chunk of rounds and a scalar
+metrics read-back. The host's only jobs are: decide early stopping, accumulate
+history, log, checkpoint, and time.
 
 Early-stopping parity (:181-192): rank 0 compares the 4-metric vector
 (accuracy, precision, recall, f1 — mean over clients) against the previous
@@ -13,6 +13,14 @@ round with ``np.allclose(atol=tolerance)``; `patience` consecutive unchanged
 rounds stop training. The reference's stop signal takes effect one round late
 because the loop-top bcast at :132 reads the PREVIOUS round's signal (:195,
 SURVEY.md §5) — fedtpu stops immediately (the lag is a bug, not semantics).
+
+Throughput knob: ``RunConfig.rounds_per_step = R`` scans R rounds inside one
+compiled program, syncing metrics to host once per R rounds. Early stopping is
+still evaluated for every round (the compiled program returns per-round
+metrics), but a stop that triggers mid-chunk is detected after the chunk
+already ran — training may overshoot by up to R-1 rounds (history is
+truncated at the stop round; final params include the overshoot). R=1
+(default) reproduces the reference cadence exactly.
 
 The metric accumulated for stopping is the reference's semantics #1 — the
 MEAN of per-client train-shard metrics (:169). The pooled semantics
@@ -23,7 +31,7 @@ broadcasts a test split it never touches, :243-246) are recorded alongside.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -74,9 +82,21 @@ class ExperimentResult:
         }
 
 
-def build_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None):
-    """Wire data -> mesh -> model -> optimizer -> compiled round. Returns
-    (round_step, state, batch, eval_step, dataset, mesh)."""
+@dataclasses.dataclass
+class Experiment:
+    """Wired-up experiment: data on the mesh + compiled-step factory."""
+
+    make_step: Callable[[int], Callable]   # rounds_per_step -> round_step fn
+    state: dict
+    batch: dict
+    eval_step: Callable
+    dataset: Dataset
+    mesh: object
+
+
+def build_experiment(cfg: ExperimentConfig,
+                     dataset: Optional[Dataset] = None) -> Experiment:
+    """Wire data -> mesh -> model -> optimizer -> compiled round factory."""
     ds = dataset or load_tabular_dataset(cfg.data)
     model_cfg = cfg.model
     if model_cfg.kind == "mlp" and model_cfg.input_dim != ds.input_dim:
@@ -99,10 +119,22 @@ def build_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None):
     state = init_federated_state(
         jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
         init_fn, tx, same_init=cfg.fed.same_init)
-    round_step = build_round_fn(mesh, apply_fn, tx, ds.num_classes,
-                                weighting=cfg.fed.weighting)
+
+    def make_step(rounds_per_step: int = 1):
+        return build_round_fn(mesh, apply_fn, tx, ds.num_classes,
+                              weighting=cfg.fed.weighting,
+                              rounds_per_step=rounds_per_step)
+
     eval_step = build_eval_fn(apply_fn, ds.num_classes)
-    return round_step, state, batch, eval_step, ds, mesh
+    return Experiment(make_step=make_step, state=state, batch=batch,
+                      eval_step=eval_step, dataset=ds, mesh=mesh)
+
+
+def _unstack_metrics(metrics: dict, take: int) -> List[dict]:
+    """Per-round metric dicts out of a (possibly R-stacked) metrics pytree."""
+    if take == 1:
+        return [metrics]
+    return [jax.tree.map(lambda v: v[j], metrics) for j in range(take)]
 
 
 def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
@@ -113,7 +145,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     history) and continue the round loop from the saved round. Pooled /
     per-client / test histories restart at the resume point; the early-stop
     comparator re-seeds from the restored history's last entry."""
-    round_step, state, batch, eval_step, ds, mesh = build_experiment(cfg, dataset)
+    exp = build_experiment(cfg, dataset)
+    state, batch, eval_step, ds = exp.state, exp.batch, exp.eval_step, exp.dataset
 
     start_round = 0
     restored_history = None
@@ -121,7 +154,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         from fedtpu.orchestration.checkpoint import latest_step, load_checkpoint
         if latest_step(cfg.run.checkpoint_dir) is not None:
             state, restored_history, start_round = load_checkpoint(
-                cfg.run.checkpoint_dir, sharding=client_sharding(mesh),
+                cfg.run.checkpoint_dir, sharding=client_sharding(exp.mesh),
                 state_like=state)
             if verbose:
                 print(f"Resumed from checkpoint at round {start_round}.",
@@ -132,6 +165,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     per_client_hist = {k: [] for k in METRIC_NAMES}
     test_hist = {k: [] for k in METRIC_NAMES}
     losses: List[np.ndarray] = []
+    sec_per_round: List[float] = []
     timer = Timer().start()
 
     prev_metric = None
@@ -150,60 +184,80 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     if ckpt_every and cfg.run.checkpoint_dir:
         from fedtpu.orchestration.checkpoint import save_checkpoint
 
-    for rnd in range(start_round, cfg.fed.rounds):
-        state, metrics = round_step(state, batch)
+    chunk = max(1, cfg.run.rounds_per_step)
+    step_fns: Dict[int, Callable] = {}
 
-        client_mean = {k: float(v) for k, v in metrics["client_mean"].items()}
-        pooled = {k: float(v) for k, v in metrics["pooled"].items()}
-        per_client = {k: np.asarray(v) for k, v in metrics["per_client"].items()}
-        losses.append(np.asarray(metrics["loss"]))
-        dt = timer.lap()
-        rounds_run = rnd + 1
+    def get_step(r: int) -> Callable:
+        if r not in step_fns:
+            step_fns[r] = exp.make_step(r)
+        return step_fns[r]
 
-        for k in METRIC_NAMES:
-            history[k].append(client_mean[k])
-            pooled_hist[k].append(pooled[k])
-            per_client_hist[k].append(per_client[k])
+    rnd = start_round
+    while rnd < cfg.fed.rounds and not stopped_early:
+        take = min(chunk, cfg.fed.rounds - rnd)
+        state, metrics = get_step(take)(state, batch)
+        per_round = _unstack_metrics(metrics, take)
+        dt = timer.lap() / take
 
-        if cfg.run.eval_test_every and (rnd + 1) % cfg.run.eval_test_every == 0:
+        for j, m in enumerate(per_round):
+            r = rnd + j
+            client_mean = {k: float(v) for k, v in m["client_mean"].items()}
+            per_client = {k: np.asarray(v) for k, v in m["per_client"].items()}
+            losses.append(np.asarray(m["loss"]))
+            sec_per_round.append(dt)
+            rounds_run = r + 1
+
+            for k in METRIC_NAMES:
+                history[k].append(client_mean[k])
+                pooled_hist[k].append(float(m["pooled"][k]))
+                per_client_hist[k].append(per_client[k])
+
+            if verbose and (r % cfg.run.log_every == 0):
+                print(f"\nRound {r + 1}:\n", flush=True)
+                if cfg.run.log_per_client:
+                    # Parity with the barrier-serialized rank-ordered prints
+                    # (FL_CustomMLP...:151-162) — here just a loop, no barriers.
+                    for c in range(cfg.shard.num_clients):
+                        vals = ", ".join(f"{k}: {per_client[k][c]:.4f}"
+                                         for k in METRIC_NAMES)
+                        print(f"  CLIENT {c} - Local Metrics (Round {r + 1}): "
+                              f"[{vals}]", flush=True)
+                gvals = ", ".join(f"{k}: {client_mean[k]:.4f}"
+                                  for k in METRIC_NAMES)
+                print(f"  Global Metrics (Round {r + 1}): [{gvals}]  "
+                      f"({dt * 1e3:.1f} ms/round)", flush=True)
+
+            # Early stopping — exact reference logic (FL_CustomMLP...:181-192).
+            cur = [client_mean[k] for k in METRIC_NAMES]
+            if prev_metric is not None and np.allclose(
+                    cur, prev_metric, atol=cfg.fed.tolerance):
+                termination_count -= 1
+                if termination_count == 0:
+                    if verbose:
+                        print("Early stopping triggered: No significant "
+                              "change in metrics for "
+                              f"{cfg.fed.termination_patience} rounds.",
+                              flush=True)
+                    stopped_early = True
+                    break
+            else:
+                prev_metric = cur
+                termination_count = cfg.fed.termination_patience
+
+        rnd += take
+
+        # Held-out eval / checkpoint at chunk boundaries when due within the
+        # chunk (with rounds_per_step=1 this is the exact per-round cadence).
+        if cfg.run.eval_test_every and any(
+                (rnd - j) % cfg.run.eval_test_every == 0
+                for j in range(take)):
             tm = eval_step(global_params(state), ds.x_test, ds.y_test)
             for k in METRIC_NAMES:
                 test_hist[k].append(float(tm[k]))
 
-        if verbose and (rnd % cfg.run.log_every == 0):
-            print(f"\nRound {rnd + 1}:\n", flush=True)
-            if cfg.run.log_per_client:
-                # Parity with the barrier-serialized rank-ordered prints
-                # (FL_CustomMLP...:151-162) — here just a loop, no barriers.
-                for c in range(cfg.shard.num_clients):
-                    vals = ", ".join(f"{k}: {per_client[k][c]:.4f}"
-                                     for k in METRIC_NAMES)
-                    print(f"  CLIENT {c} - Local Metrics (Round {rnd + 1}): "
-                          f"[{vals}]", flush=True)
-            gvals = ", ".join(f"{k}: {client_mean[k]:.4f}"
-                              for k in METRIC_NAMES)
-            print(f"  Global Metrics (Round {rnd + 1}): [{gvals}]  "
-                  f"({dt * 1e3:.1f} ms)", flush=True)
-
-        if ckpt_every and cfg.run.checkpoint_dir and \
-                (rnd + 1) % ckpt_every == 0:
-            save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd + 1)
-
-        # Early stopping — exact reference logic (FL_CustomMLP...:181-192).
-        cur = [client_mean[k] for k in METRIC_NAMES]
-        if prev_metric is not None and np.allclose(
-                cur, prev_metric, atol=cfg.fed.tolerance):
-            termination_count -= 1
-            if termination_count == 0:
-                if verbose:
-                    print("Early stopping triggered: No significant change in "
-                          f"metrics for {cfg.fed.termination_patience} rounds.",
-                          flush=True)
-                stopped_early = True
-                break
-        else:
-            prev_metric = cur
-            termination_count = cfg.fed.termination_patience
+        if ckpt_every and cfg.run.checkpoint_dir and any(
+                (rnd - j) % ckpt_every == 0 for j in range(take)):
+            save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd)
 
     return ExperimentResult(
         global_metrics=history,
@@ -211,7 +265,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         per_client_metrics=per_client_hist,
         test_metrics=test_hist,
         loss=losses,
-        sec_per_round=list(timer.laps),
+        sec_per_round=sec_per_round,
         rounds_run=rounds_run,
         stopped_early=stopped_early,
         final_params=to_numpy(global_params(state)),

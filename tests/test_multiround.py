@@ -1,0 +1,79 @@
+"""rounds_per_step: R rounds scanned in one compiled program must reproduce
+the R-single-round trajectory exactly."""
+
+import numpy as np
+import jax
+
+from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                           ModelConfig, OptimConfig, RunConfig, ShardConfig)
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.orchestration.loop import run_experiment
+from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.parallel.round import build_round_fn, init_federated_state
+
+
+def test_scanned_rounds_match_single_round_trajectory():
+    x, y = synthetic_income_like(256, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=8, shuffle=False))
+    mesh = make_mesh(num_clients=8)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6, hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig())
+    shard = client_sharding(mesh)
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+
+    state_a = init_federated_state(jax.random.key(3), mesh, 8, init_fn, tx)
+    state_b = jax.tree.map(lambda v: v, state_a)
+
+    single = build_round_fn(mesh, apply_fn, tx, 2, rounds_per_step=1)
+    scanned = build_round_fn(mesh, apply_fn, tx, 2, rounds_per_step=4)
+
+    accs = []
+    for _ in range(4):
+        state_a, m = single(state_a, batch)
+        accs.append(float(m["client_mean"]["accuracy"]))
+
+    state_b, ms = scanned(state_b, batch)
+    np.testing.assert_allclose(
+        np.asarray(ms["client_mean"]["accuracy"]), accs, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(state_b["params"]["layers"][0]["w"]),
+        np.asarray(state_a["params"]["layers"][0]["w"]), atol=1e-5)
+    assert int(state_b["round"]) == int(state_a["round"]) == 4
+    # Stacked metric shapes: (R,) scalars, (R, C) per-client.
+    assert ms["loss"].shape == (4, 8)
+    assert ms["per_client"]["f1"].shape == (4, 8)
+    assert ms["pooled"]["accuracy"].shape == (4,)
+
+
+def test_loop_with_chunking_matches_unchunked_history():
+    base = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=7),
+    )
+    res1 = run_experiment(base, verbose=False)
+    res3 = run_experiment(
+        base.replace(run=RunConfig(rounds_per_step=3)), verbose=False)
+    assert res3.rounds_run == 7  # chunks 3+3+1, remainder handled
+    np.testing.assert_allclose(res3.global_metrics["accuracy"],
+                               res1.global_metrics["accuracy"], atol=1e-6)
+    np.testing.assert_allclose(res3.pooled_metrics["f1"],
+                               res1.pooled_metrics["f1"], atol=1e-6)
+
+
+def test_chunked_early_stop_truncates_history():
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=50, termination_patience=3, tolerance=1.0),
+        run=RunConfig(rounds_per_step=8),
+    )
+    res = run_experiment(cfg, verbose=False)
+    assert res.stopped_early
+    # Same stop round as the unchunked case: prev set at r1, countdown r2-r4.
+    assert res.rounds_run == 4
+    assert len(res.global_metrics["accuracy"]) == 4
