@@ -82,8 +82,8 @@ def bench_fedtpu(ds) -> dict:
     sec_per_round = (time.perf_counter() - t0) / (ROUNDS * ROUNDS_PER_STEP)
     return {"sec_per_round": sec_per_round,
             "rounds_per_step": ROUNDS_PER_STEP,
-            "accuracy": float(np.asarray(
-                metrics["client_mean"]["accuracy"])[-1]),
+            "accuracy": float(np.atleast_1d(
+                np.asarray(metrics["client_mean"]["accuracy"]))[-1]),
             "devices": len(mesh.devices.ravel()),
             "backend": mesh.devices.ravel()[0].platform}
 

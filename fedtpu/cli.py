@@ -41,6 +41,8 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    help="comma-separated, e.g. 50,200")
     p.add_argument("--learning-rate", type=float, default=None)
     p.add_argument("--weighting", choices=["data_size", "uniform"], default=None)
+    p.add_argument("--participation-rate", type=float, default=None,
+                   help="per-round client sampling probability (default 1.0)")
     p.add_argument("--shard-strategy",
                    choices=["contiguous", "label_sort", "dirichlet"],
                    default=None)
@@ -79,6 +81,9 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         fed = dataclasses.replace(fed, rounds=args.rounds)
     if args.weighting is not None:
         fed = dataclasses.replace(fed, weighting=args.weighting)
+    if args.participation_rate is not None:
+        fed = dataclasses.replace(fed,
+                                  participation_rate=args.participation_rate)
     run_kw = {}
     if args.checkpoint_dir is not None:
         run_kw["checkpoint_dir"] = args.checkpoint_dir

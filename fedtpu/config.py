@@ -119,6 +119,11 @@ class FedConfig:
     weighting: str = "data_size"         # 'data_size' (FL_CustomMLP...:112-115) | 'uniform' (hyperparameters_tuning.py:37)
     termination_patience: int = 10       # FL_CustomMLP...:122
     tolerance: float = 1e-4              # FL_CustomMLP...:122
+    # Partial participation (classic FedAvg client sampling; also serves as
+    # straggler/dropout fault injection). 1.0 == reference behavior: every
+    # client trains every round. See fedtpu.parallel.round.
+    participation_rate: float = 1.0
+    participation_seed: int = 0
     # Each client starts from an independent random init, matching the
     # reference where every rank constructs an unseeded torch model
     # (FL_CustomMLP...:42). Set True to start all clients identical.

@@ -72,13 +72,16 @@ class ExperimentResult:
 
     def summary(self) -> dict:
         last = {k: v[-1] for k, v in self.global_metrics.items() if v}
+        # Exclude the first chunk's entries from the mean: its compile time is
+        # smeared over rounds_per_step per-round entries, not just the first.
+        warm = max(1, self.config.run.rounds_per_step)
+        steady = (self.sec_per_round[warm:] if len(self.sec_per_round) > warm
+                  else self.sec_per_round or [0.0])
         return {
             "rounds_run": self.rounds_run,
             "stopped_early": self.stopped_early,
             "final_global_metrics": last,
-            "mean_sec_per_round": (float(np.mean(self.sec_per_round[1:]))
-                                   if len(self.sec_per_round) > 1
-                                   else float(np.mean(self.sec_per_round or [0.0]))),
+            "mean_sec_per_round": float(np.mean(steady)),
         }
 
 
@@ -123,7 +126,9 @@ def build_experiment(cfg: ExperimentConfig,
     def make_step(rounds_per_step: int = 1):
         return build_round_fn(mesh, apply_fn, tx, ds.num_classes,
                               weighting=cfg.fed.weighting,
-                              rounds_per_step=rounds_per_step)
+                              rounds_per_step=rounds_per_step,
+                              participation_rate=cfg.fed.participation_rate,
+                              participation_seed=cfg.fed.participation_seed)
 
     eval_step = build_eval_fn(apply_fn, ds.num_classes)
     return Experiment(make_step=make_step, state=state, batch=batch,
@@ -246,14 +251,24 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
 
         rnd += take
 
+        if stopped_early:
+            # The chunk overshot the stop round; don't checkpoint or eval the
+            # overshoot state (the unchunked loop's `break` skips these too).
+            break
+
         # Held-out eval / checkpoint at chunk boundaries when due within the
         # chunk (with rounds_per_step=1 this is the exact per-round cadence).
-        if cfg.run.eval_test_every and any(
-                (rnd - j) % cfg.run.eval_test_every == 0
-                for j in range(take)):
-            tm = eval_step(global_params(state), ds.x_test, ds.y_test)
-            for k in METRIC_NAMES:
-                test_hist[k].append(float(tm[k]))
+        # Every due round appends an entry so test_hist round-alignment
+        # matches the unchunked run; due rounds inside one chunk share the
+        # chunk-end global params (documented approximation).
+        if cfg.run.eval_test_every:
+            due = sum(1 for j in range(take)
+                      if (rnd - j) % cfg.run.eval_test_every == 0)
+            if due:
+                tm = eval_step(global_params(state), ds.x_test, ds.y_test)
+                for _ in range(due):
+                    for k in METRIC_NAMES:
+                        test_hist[k].append(float(tm[k]))
 
         if ckpt_every and cfg.run.checkpoint_dir and any(
                 (rnd - j) % ckpt_every == 0 for j in range(take)):

