@@ -31,6 +31,14 @@ def _hidden_sizes(text: str):
             f"expected comma-separated integers, got {text!r}")
 
 
+def _participation_rate(text: str) -> float:
+    rate = float(text)
+    if not 0.0 < rate <= 1.0:
+        raise argparse.ArgumentTypeError(
+            f"participation rate must be in (0, 1], got {rate}")
+    return rate
+
+
 def _add_common_overrides(p: argparse.ArgumentParser):
     p.add_argument("--preset", default="income-8", choices=sorted(PRESETS))
     p.add_argument("--csv", default=None, help="dataset CSV path")
@@ -41,8 +49,10 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    help="comma-separated, e.g. 50,200")
     p.add_argument("--learning-rate", type=float, default=None)
     p.add_argument("--weighting", choices=["data_size", "uniform"], default=None)
-    p.add_argument("--participation-rate", type=float, default=None,
-                   help="per-round client sampling probability (default 1.0)")
+    p.add_argument("--participation-rate", type=_participation_rate,
+                   default=None,
+                   help="per-round client sampling probability in (0, 1] "
+                        "(default 1.0)")
     p.add_argument("--shard-strategy",
                    choices=["contiguous", "label_sort", "dirichlet"],
                    default=None)

@@ -29,11 +29,20 @@ METRIC_NAMES = ("accuracy", "precision", "recall", "f1")
 
 def confusion_matrix(labels: jax.Array, preds: jax.Array, mask: jax.Array,
                      num_classes: int) -> jax.Array:
-    """(K, K) matrix, rows = true class, cols = predicted class, masked."""
-    idx = labels.astype(jnp.int32) * num_classes + preds.astype(jnp.int32)
-    flat = jnp.zeros((num_classes * num_classes,), jnp.float32)
-    flat = flat.at[idx].add(mask.astype(jnp.float32))
-    return flat.reshape(num_classes, num_classes)
+    """(K, K) matrix, rows = true class, cols = predicted class, masked.
+
+    Computed as ``(onehot(labels) * mask).T @ onehot(preds)`` — a (K,N)@(N,K)
+    contraction the MXU executes in one pass — instead of the scatter-add
+    (``.at[idx].add``) formulation, which XLA lowers to a serialized scatter
+    on TPU. Same value, orders faster in the round hot loop.
+    """
+    lab = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+    pred = jax.nn.one_hot(preds, num_classes, dtype=jnp.float32)
+    # HIGHEST precision: the MXU's default bf16 multiply-accumulate loses
+    # integer exactness above 256, corrupting counts on large shards.
+    return jnp.einsum("nk,n,nl->kl", lab, mask.astype(jnp.float32), pred,
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 def metrics_from_confusion(conf: jax.Array) -> dict:

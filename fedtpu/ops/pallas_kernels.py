@@ -1,0 +1,137 @@
+"""Pallas TPU kernels for the fedtpu hot ops.
+
+The reference has no custom kernels anywhere (its only accelerator touchpoint
+is torch's prebuilt CUDA dispatch, FL_CustomMLP...:33 — SURVEY.md §2); these
+are fedtpu's TPU-native equivalents for the two per-round hot paths:
+
+* ``fused_mlp_forward`` — the whole Linear->ReLU->...->Linear stack in ONE
+  kernel: the input tile is DMA'd to VMEM once, every layer's matmul runs on
+  the MXU with activations staying resident in VMEM, and only the logits go
+  back to HBM. XLA already fuses the elementwise ReLU/bias into the matmuls;
+  what it does not do is keep the inter-layer activations out of HBM for the
+  whole stack — for the income MLP (14->50->200->2) that halves HBM traffic.
+* ``weighted_average_clients`` — the FedAvg reduction over a device's local
+  client block as a single (1,C)@(C,D) MXU contraction in VMEM (the in-kernel
+  analogue of the rank-0 weighted average, FL_CustomMLP...:108-116).
+
+Both kernels are shape-generic (weights are small enough to live whole in
+VMEM; the row axis is gridded) and run in interpret mode on CPU, which is how
+the unit tests check bit-parity against the pure-XLA implementations.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Per-kernel VMEM budget guard (per core ~16 MB; leave headroom for weights,
+# double buffering, and the output tile).
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _row_tile(n_rows: int, widest: int) -> int:
+    """Pick a row-tile size: multiple of 8 (f32 sublane), capped so the
+    widest activation tile stays within the VMEM budget."""
+    cap = max(8, _VMEM_BUDGET_BYTES // max(1, widest * 4))
+    cap = (cap // 8) * 8
+    tile = min(512, cap)
+    while n_rows % tile:
+        tile -= 8
+        if tile <= 8:
+            return 8
+    return tile
+
+
+def _mlp_kernel(num_layers: int, *refs):
+    x_ref = refs[0]
+    out_ref = refs[-1]
+    h = x_ref[:]
+    for i in range(num_layers):
+        w = refs[1 + 2 * i][:]
+        b = refs[2 + 2 * i][:]
+        h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b
+        if i < num_layers - 1:
+            h = jnp.maximum(h, 0.0)
+    out_ref[:] = h
+
+
+def fused_mlp_forward(params, x: jax.Array,
+                      interpret: Optional[bool] = None) -> jax.Array:
+    """Pallas drop-in for ``fedtpu.models.mlp.mlp_apply`` (float32 path).
+
+    x: (N, D) with N a multiple of 8 (the data pipeline pads shards to a
+    multiple of 8 — fedtpu.data.sharding.pack_clients). Falls back to a
+    row-gridded launch when the batch is too tall for VMEM.
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    layers = params["layers"]
+    num_layers = len(layers)
+    n, d_in = x.shape
+    dims = [d_in] + [l["w"].shape[1] for l in layers]
+    widest = max(dims)
+    tile = _row_tile(n, widest)
+    grid = (n // tile,)
+
+    weight_args = []
+    in_specs = [pl.BlockSpec((tile, d_in), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)]
+    for l in layers:
+        w, b = l["w"], l["b"]
+        weight_args.extend([w.astype(jnp.float32),
+                            b.astype(jnp.float32).reshape(1, -1)])
+        in_specs.append(pl.BlockSpec(w.shape, lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        in_specs.append(pl.BlockSpec((1, b.shape[0]), lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+
+    out_dim = dims[-1]
+    return pl.pallas_call(
+        functools.partial(_mlp_kernel, num_layers),
+        out_shape=jax.ShapeDtypeStruct((n, out_dim), jnp.float32),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((tile, out_dim), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x.astype(jnp.float32), *weight_args)
+
+
+def _wavg_kernel(x_ref, w_ref, out_ref):
+    # (1, C) @ (C, D) on the MXU: the whole weighted average in one pass.
+    # HIGHEST precision: the MXU's default bf16 multiply costs ~1e-3 relative
+    # error, unacceptable for parameter averaging.
+    out_ref[:] = jnp.dot(w_ref[:], x_ref[:],
+                         preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)
+
+
+def weighted_average_clients(stacked: jax.Array, weights: jax.Array,
+                             interpret: Optional[bool] = None) -> jax.Array:
+    """Weighted average over the leading clients axis of ``stacked`` (C, D):
+    ``sum_c weights[c] * stacked[c] / sum_c weights[c]`` — the FedAvg
+    aggregation (FL_CustomMLP...:112-115) as one VMEM-resident contraction."""
+    if interpret is None:
+        interpret = _auto_interpret()
+    c, d = stacked.shape
+    total = jnp.maximum(weights.sum(), 1e-30)
+    wn = (weights / total).reshape(1, c).astype(jnp.float32)
+    out = pl.pallas_call(
+        _wavg_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, d), jnp.float32),
+        in_specs=[pl.BlockSpec((c, d), memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, c), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, d), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(stacked.astype(jnp.float32), wn)
+    return out[0]

@@ -13,25 +13,25 @@ from fedtpu.parallel import make_mesh, client_sharding
 from fedtpu.parallel.round import build_round_fn, init_federated_state
 
 
-def _setup(**round_kw):
+def _setup(lr=0.004, **round_kw):
     x, y = synthetic_income_like(256, 6, 2)
     packed = pack_clients(x, y, ShardConfig(num_clients=8, shuffle=False))
     mesh = make_mesh(num_clients=8)
     init_fn, apply_fn = build_model(ModelConfig(input_dim=6, hidden_sizes=(8,)))
-    tx = build_optimizer(OptimConfig())
+    tx = build_optimizer(OptimConfig(learning_rate=lr))
     shard = client_sharding(mesh)
     batch = {k: jax.device_put(v, shard) for k, v in
              {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
     state = init_federated_state(jax.random.key(2), mesh, 8, init_fn, tx,
                                  same_init=False)
     step = build_round_fn(mesh, apply_fn, tx, 2, **round_kw)
-    return state, batch, step
+    return state, batch, step, packed
 
 
 def test_full_participation_is_default_behavior():
-    state, batch, step_default = _setup()
+    state, batch, step_default, _ = _setup()
     state2 = jax.tree.map(lambda v: v, state)
-    _, batch2, step_rate1 = _setup(participation_rate=1.0)
+    _, batch2, step_rate1, _ = _setup(participation_rate=1.0)
     a, _ = step_default(state, batch)
     b, _ = step_rate1(state2, batch)
     np.testing.assert_allclose(np.asarray(a["params"]["layers"][0]["w"]),
@@ -40,7 +40,7 @@ def test_full_participation_is_default_behavior():
 
 
 def test_sampling_is_deterministic_in_seed():
-    state, batch, step = _setup(participation_rate=0.5, participation_seed=7)
+    state, batch, step, _ = _setup(participation_rate=0.5, participation_seed=7)
     state2 = jax.tree.map(lambda v: v, state)
     a, _ = step(state, batch)
     b, _ = step(state2, batch)
@@ -51,7 +51,7 @@ def test_sampling_is_deterministic_in_seed():
 
 def test_nonparticipants_keep_optimizer_moments():
     # With rate 0.0 nobody trains: params and moments must be unchanged.
-    state, batch, step = _setup(participation_rate=1e-9)
+    state, batch, step, _ = _setup(participation_rate=1e-9)
     before_w = np.asarray(state["params"]["layers"][0]["w"])
     before_mu = np.asarray(jax.tree.leaves(state["opt_state"])[1])
     new_state, _ = step(state, batch)
@@ -63,28 +63,32 @@ def test_nonparticipants_keep_optimizer_moments():
 
 
 def test_sampled_average_over_participants_only():
-    # rate 0.5, lr=0: trained == old params, so the new global must equal the
-    # weighted average over the PARTICIPANTS' initial params only. We recover
-    # the participant set from which clients' moments moved.
-    state, batch, step = _setup(participation_rate=0.5, participation_seed=3)
-    tx_probe = None
-    before = np.asarray(state["params"]["layers"][0]["w"])
+    # lr=0 makes the train step a parameter no-op (Adam moments still move for
+    # participants, which is how we recover the sampled subset), so the new
+    # global params must equal the data-size-weighted average over the
+    # PARTICIPANTS' initial params ONLY — non-participants' params must not
+    # leak into the average.
+    state, batch, step, packed = _setup(lr=0.0, participation_rate=0.5,
+                                        participation_seed=3)
+    before = np.asarray(state["params"]["layers"][0]["w"])  # (C, in, out)
     mu_before = np.asarray(jax.tree.leaves(state["opt_state"])[1])
     new_state, _ = step(state, batch)
     after = np.asarray(new_state["params"]["layers"][0]["w"])
     mu_after = np.asarray(jax.tree.leaves(new_state["opt_state"])[1])
 
-    moved = np.array([not np.allclose(mu_before[c], mu_after[c])
-                      for c in range(8)])
-    assert 0 < moved.sum() < 8  # actually sampled a strict subset
-    # Every client ends with the same global params.
-    for c in range(1, 8):
-        np.testing.assert_allclose(after[c], after[0], atol=0)
+    part = np.array([not np.allclose(mu_before[c], mu_after[c])
+                     for c in range(8)])
+    assert 0 < part.sum() < 8  # actually sampled a strict subset
+
+    w = packed.counts.astype(np.float64) * part
+    expected = (before * (w / w.sum())[:, None, None]).sum(axis=0)
+    for c in range(8):
+        np.testing.assert_allclose(after[c], expected, atol=1e-6)
 
 
 def test_different_rounds_sample_different_subsets():
-    state, batch, step = _setup(participation_rate=0.5, participation_seed=3,
-                                rounds_per_step=4)
+    state, batch, step, _ = _setup(participation_rate=0.5, participation_seed=3,
+                                   rounds_per_step=4)
     mu_before = np.asarray(jax.tree.leaves(state["opt_state"])[1])
     new_state, metrics = step(state, batch)
     # Across 4 rounds with rate .5, at least 5 of 8 clients should have
