@@ -1,0 +1,93 @@
+"""Client-scaling benchmark: sec/round at 1 -> 8 -> 32 clients (BASELINE.md
+config matrix), plus the CIFAR-10 ConvNet payload stress config.
+
+Prints one JSON line per config. On a single chip, clients beyond the device
+count vmap-oversubscribe (the analogue of `mpirun -np 32` on one node); on a
+v4-8/v4-32 the same code lays one client per core.
+
+Usage: python benchmarks/scaling.py [--rounds 20] [--rounds-per-step 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from fedtpu.config import (DataConfig, ModelConfig, OptimConfig, ShardConfig,
+                           default_income_csv)
+from fedtpu.data.cifar10 import load_cifar10
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import load_tabular_dataset
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.parallel.round import build_round_fn, init_federated_state
+
+
+def bench_config(name: str, ds, model_cfg: ModelConfig, num_clients: int,
+                 rounds: int, rounds_per_step: int) -> dict:
+    mesh = make_mesh(num_clients=num_clients)
+    shard = client_sharding(mesh)
+    packed = pack_clients(ds.x_train, ds.y_train,
+                          ShardConfig(num_clients=num_clients))
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    init_fn, apply_fn = build_model(model_cfg)
+    tx = build_optimizer(OptimConfig())
+    state = init_federated_state(jax.random.key(0), mesh, num_clients,
+                                 init_fn, tx)
+    step = build_round_fn(mesh, apply_fn, tx, ds.num_classes,
+                          rounds_per_step=rounds_per_step)
+
+    for _ in range(3):                      # compile + executable warmup
+        state, m = step(state, batch)
+    jax.block_until_ready(state["params"])
+    t0 = time.perf_counter()
+    iters = max(3, rounds // rounds_per_step)
+    for _ in range(iters):
+        state, m = step(state, batch)
+    jax.block_until_ready(state["params"])
+    sec = (time.perf_counter() - t0) / (iters * rounds_per_step)
+    return {
+        "config": name, "num_clients": num_clients,
+        "sec_per_round": round(sec, 9),
+        "devices": len(mesh.devices.ravel()),
+        "backend": mesh.devices.ravel()[0].platform,
+        "train_rows": int(len(ds.x_train)),
+        "params_dtype": model_cfg.param_dtype,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--rounds-per-step", type=int, default=10)
+    ap.add_argument("--skip-cifar", action="store_true")
+    args = ap.parse_args()
+
+    income = load_tabular_dataset(DataConfig(csv_path=default_income_csv()))
+    mlp = ModelConfig(input_dim=income.input_dim,
+                      num_classes=income.num_classes)
+    for c in (1, 8, 32):
+        print(json.dumps(bench_config(f"income-mlp-{c}", income, mlp, c,
+                                      args.rounds, args.rounds_per_step)),
+              flush=True)
+
+    if not args.skip_cifar:
+        cifar = load_cifar10(synthetic_rows=4096)
+        conv = ModelConfig(kind="convnet", num_classes=10,
+                           hidden_sizes=(256,), compute_dtype="bfloat16")
+        print(json.dumps(bench_config("cifar10-convnet-32", cifar, conv, 32,
+                                      args.rounds, args.rounds_per_step)),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -58,11 +58,17 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    default=None)
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"],
                    default=None)
+    p.add_argument("--use-pallas", action="store_true",
+                   help="evaluate with the Pallas fused-MLP kernel")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=None)
     p.add_argument("--eval-test-every", type=int, default=None)
     p.add_argument("--rounds-per-step", type=int, default=None,
                    help="rounds scanned per compiled step (throughput knob)")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of the round loop here")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="append one JSON line of metrics per round")
     p.add_argument("--log-per-client", action="store_true")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--json", action="store_true",
@@ -73,8 +79,11 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
     data, shard, model = cfg.data, cfg.shard, cfg.model
     optim, fed, run = cfg.optim, cfg.fed, cfg.run
     if args.csv is not None:
-        # --csv "" explicitly selects the synthetic dataset.
-        data = dataclasses.replace(data, csv_path=args.csv or None)
+        # --csv "" explicitly selects the synthetic dataset. Clearing
+        # dataset_name makes --csv win over presets that select a named
+        # loader (e.g. cifar10-32), which would otherwise ignore it.
+        data = dataclasses.replace(data, csv_path=args.csv or None,
+                                   dataset_name=None)
     if args.label_column is not None:
         data = dataclasses.replace(data, label_column=args.label_column)
     if args.num_clients is not None:
@@ -85,6 +94,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         model = dataclasses.replace(model, hidden_sizes=args.hidden_sizes)
     if args.compute_dtype is not None:
         model = dataclasses.replace(model, compute_dtype=args.compute_dtype)
+    if args.use_pallas:
+        model = dataclasses.replace(model, use_pallas=True)
     if args.learning_rate is not None:
         optim = dataclasses.replace(optim, learning_rate=args.learning_rate)
     if args.rounds is not None:
@@ -103,6 +114,10 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["eval_test_every"] = args.eval_test_every
     if args.rounds_per_step is not None:
         run_kw["rounds_per_step"] = args.rounds_per_step
+    if args.profile_dir is not None:
+        run_kw["profile_dir"] = args.profile_dir
+    if args.metrics_jsonl is not None:
+        run_kw["metrics_jsonl"] = args.metrics_jsonl
     if args.log_per_client:
         run_kw["log_per_client"] = True
     if run_kw:

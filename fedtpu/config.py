@@ -46,6 +46,7 @@ class DataConfig:
     """
 
     csv_path: Optional[str] = None       # None => synthetic income-like data
+    dataset_name: Optional[str] = None   # 'cifar10' selects the image loader (fedtpu.data.cifar10); None = tabular/CSV
     label_column: str = "income"         # FL_SkLearn...:164 ('Outcome' for the diabetes path, FL_CustomMLP...:217)
     test_size: float = 0.2               # FL_CustomMLP...:239
     split_seed: int = 42                 # random_state=42 everywhere in the reference
@@ -92,6 +93,9 @@ class ModelConfig:
     conv_channels: Tuple[int, ...] = (32, 64)
     param_dtype: str = "float32"
     compute_dtype: str = "float32"       # set 'bfloat16' to run matmuls on the MXU in bf16
+    # Use the Pallas fused-MLP forward kernel for evaluation (MLP, f32 only).
+    # The train step stays on the XLA path (the kernel defines no custom VJP).
+    use_pallas: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -147,7 +151,8 @@ class RunConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0            # 0 = disabled
     eval_test_every: int = 0             # 0 = disabled; reference never uses its test split (FL_CustomMLP...:243-246)
-    profile_dir: Optional[str] = None    # jax.profiler trace output
+    profile_dir: Optional[str] = None    # jax.profiler trace of the round loop
+    metrics_jsonl: Optional[str] = None  # append one JSON line per round
     mesh_devices: int = 0                # 0 = all visible devices
 
 
@@ -197,9 +202,10 @@ PRESETS = {
         fed=FedConfig(rounds=300),
     ),
     # 5: CIFAR-10 2-layer ConvNet, 32 clients — pmean payload stress.
+    # Real CIFAR-10 when cifar-10-batches-py exists locally, synthetic
+    # CIFAR-shaped data otherwise (zero-egress environments).
     "cifar10-32": ExperimentConfig(
-        data=DataConfig(csv_path=None, synthetic_rows=4096,
-                        synthetic_features=32 * 32 * 3, synthetic_classes=10),
+        data=DataConfig(dataset_name="cifar10", synthetic_rows=4096),
         shard=ShardConfig(num_clients=32),
         model=ModelConfig(kind="convnet", num_classes=10,
                           hidden_sizes=(256,), compute_dtype="bfloat16"),

@@ -4,3 +4,15 @@ from fedtpu.data.sharding import (  # noqa: F401
     pack_clients,
     ClientBatch,
 )
+
+
+def load_dataset(cfg) -> Dataset:
+    """Single dispatch point for ``DataConfig.dataset_name`` — every consumer
+    (run/sweep/parity) resolves data through here so named datasets like
+    'cifar10' are honored everywhere, not just in ``build_experiment``."""
+    if cfg.dataset_name == "cifar10":
+        from fedtpu.data.cifar10 import load_cifar10
+        return load_cifar10(synthetic_rows=cfg.synthetic_rows)
+    if cfg.dataset_name is not None:
+        raise ValueError(f"unknown dataset_name: {cfg.dataset_name!r}")
+    return load_tabular_dataset(cfg)

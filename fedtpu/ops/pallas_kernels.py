@@ -69,15 +69,19 @@ def fused_mlp_forward(params, x: jax.Array,
                       interpret: Optional[bool] = None) -> jax.Array:
     """Pallas drop-in for ``fedtpu.models.mlp.mlp_apply`` (float32 path).
 
-    x: (N, D) with N a multiple of 8 (the data pipeline pads shards to a
-    multiple of 8 — fedtpu.data.sharding.pack_clients). Falls back to a
-    row-gridded launch when the batch is too tall for VMEM.
+    Any (N, D) input: N is zero-padded up to a row-tile multiple internally
+    and the padding rows are sliced off the output, so callers outside the
+    padded pipeline (e.g. raw test splits) are safe. Row-gridded when the
+    batch is too tall for one VMEM tile.
     """
     if interpret is None:
         interpret = _auto_interpret()
     layers = params["layers"]
     num_layers = len(layers)
-    n, d_in = x.shape
+    n_orig, d_in = x.shape
+    n = -(-n_orig // 8) * 8
+    if n != n_orig:
+        x = jnp.pad(x, ((0, n - n_orig), (0, 0)))
     dims = [d_in] + [l["w"].shape[1] for l in layers]
     widest = max(dims)
     tile = _row_tile(n, widest)
@@ -96,15 +100,22 @@ def fused_mlp_forward(params, x: jax.Array,
                                      memory_space=pltpu.VMEM))
 
     out_dim = dims[-1]
-    return pl.pallas_call(
+    # Inside shard_map (check_vma=True) the output's varying-manual-axes must
+    # be declared explicitly; propagate the input's.
+    try:
+        vma = jax.typeof(x).vma
+    except Exception:
+        vma = frozenset()
+    out = pl.pallas_call(
         functools.partial(_mlp_kernel, num_layers),
-        out_shape=jax.ShapeDtypeStruct((n, out_dim), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n, out_dim), jnp.float32, vma=vma),
         grid=grid,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((tile, out_dim), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )(x.astype(jnp.float32), *weight_args)
+    return out[:n_orig] if n != n_orig else out
 
 
 def _wavg_kernel(x_ref, w_ref, out_ref):

@@ -31,14 +31,16 @@ broadcasts a test split it never touches, :243-246) are recorded alongside.
 from __future__ import annotations
 
 import dataclasses
+import json
 from typing import Callable, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from fedtpu.config import ExperimentConfig
+from fedtpu.data import load_dataset
 from fedtpu.data.sharding import pack_clients
-from fedtpu.data.tabular import load_tabular_dataset, Dataset
+from fedtpu.data.tabular import Dataset
 from fedtpu.models import build_model
 from fedtpu.ops import build_optimizer
 from fedtpu.ops.metrics import METRIC_NAMES
@@ -100,7 +102,7 @@ class Experiment:
 def build_experiment(cfg: ExperimentConfig,
                      dataset: Optional[Dataset] = None) -> Experiment:
     """Wire data -> mesh -> model -> optimizer -> compiled round factory."""
-    ds = dataset or load_tabular_dataset(cfg.data)
+    ds = dataset if dataset is not None else load_dataset(cfg.data)
     model_cfg = cfg.model
     if model_cfg.kind == "mlp" and model_cfg.input_dim != ds.input_dim:
         model_cfg = dataclasses.replace(model_cfg, input_dim=ds.input_dim)
@@ -123,6 +125,16 @@ def build_experiment(cfg: ExperimentConfig,
         jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
         init_fn, tx, same_init=cfg.fed.same_init)
 
+    # Opt-in Pallas fused forward for the held-out eval (a plain jit, outside
+    # shard_map; the in-round eval stays on the XLA path, which shard_map's
+    # scan requires in interpret mode).
+    eval_apply = apply_fn
+    if (model_cfg.use_pallas and model_cfg.kind == "mlp"
+            and model_cfg.param_dtype == "float32"
+            and model_cfg.compute_dtype == "float32"):
+        from fedtpu.ops.pallas_kernels import fused_mlp_forward
+        eval_apply = fused_mlp_forward
+
     def make_step(rounds_per_step: int = 1):
         return build_round_fn(mesh, apply_fn, tx, ds.num_classes,
                               weighting=cfg.fed.weighting,
@@ -130,7 +142,7 @@ def build_experiment(cfg: ExperimentConfig,
                               participation_rate=cfg.fed.participation_rate,
                               participation_seed=cfg.fed.participation_seed)
 
-    eval_step = build_eval_fn(apply_fn, ds.num_classes)
+    eval_step = build_eval_fn(eval_apply, ds.num_classes)
     return Experiment(make_step=make_step, state=state, batch=batch,
                       eval_step=eval_step, dataset=ds, mesh=mesh)
 
@@ -197,82 +209,109 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             step_fns[r] = exp.make_step(r)
         return step_fns[r]
 
-    rnd = start_round
-    while rnd < cfg.fed.rounds and not stopped_early:
-        take = min(chunk, cfg.fed.rounds - rnd)
-        state, metrics = get_step(take)(state, batch)
-        per_round = _unstack_metrics(metrics, take)
-        dt = timer.lap() / take
+    jsonl = (open(cfg.run.metrics_jsonl, "a")
+             if cfg.run.metrics_jsonl else None)
+    if cfg.run.profile_dir:
+        # Tracing subsystem the reference lacks entirely (SURVEY.md §5):
+        # capture a device profile of the round loop for xprof/tensorboard.
+        jax.profiler.start_trace(cfg.run.profile_dir)
 
-        for j, m in enumerate(per_round):
-            r = rnd + j
-            client_mean = {k: float(v) for k, v in m["client_mean"].items()}
-            per_client = {k: np.asarray(v) for k, v in m["per_client"].items()}
-            losses.append(np.asarray(m["loss"]))
-            sec_per_round.append(dt)
-            rounds_run = r + 1
+    # try/finally so a mid-run failure (OOM, Ctrl-C, I/O error) still
+    # finalizes the profiler trace and closes the jsonl handle — the trace
+    # exists precisely to diagnose such runs.
+    try:
+        rnd = start_round
+        while rnd < cfg.fed.rounds and not stopped_early:
+            take = min(chunk, cfg.fed.rounds - rnd)
+            state, metrics = get_step(take)(state, batch)
+            per_round = _unstack_metrics(metrics, take)
+            dt = timer.lap() / take
 
-            for k in METRIC_NAMES:
-                history[k].append(client_mean[k])
-                pooled_hist[k].append(float(m["pooled"][k]))
-                per_client_hist[k].append(per_client[k])
+            for j, m in enumerate(per_round):
+                r = rnd + j
+                client_mean = {k: float(v) for k, v in m["client_mean"].items()}
+                per_client = {k: np.asarray(v) for k, v in m["per_client"].items()}
+                losses.append(np.asarray(m["loss"]))
+                sec_per_round.append(dt)
+                rounds_run = r + 1
 
-            if verbose and (r % cfg.run.log_every == 0):
-                print(f"\nRound {r + 1}:\n", flush=True)
-                if cfg.run.log_per_client:
-                    # Parity with the barrier-serialized rank-ordered prints
-                    # (FL_CustomMLP...:151-162) — here just a loop, no barriers.
-                    for c in range(cfg.shard.num_clients):
-                        vals = ", ".join(f"{k}: {per_client[k][c]:.4f}"
-                                         for k in METRIC_NAMES)
-                        print(f"  CLIENT {c} - Local Metrics (Round {r + 1}): "
-                              f"[{vals}]", flush=True)
-                gvals = ", ".join(f"{k}: {client_mean[k]:.4f}"
-                                  for k in METRIC_NAMES)
-                print(f"  Global Metrics (Round {r + 1}): [{gvals}]  "
-                      f"({dt * 1e3:.1f} ms/round)", flush=True)
+                for k in METRIC_NAMES:
+                    history[k].append(client_mean[k])
+                    pooled_hist[k].append(float(m["pooled"][k]))
+                    per_client_hist[k].append(per_client[k])
 
-            # Early stopping — exact reference logic (FL_CustomMLP...:181-192).
-            cur = [client_mean[k] for k in METRIC_NAMES]
-            if prev_metric is not None and np.allclose(
-                    cur, prev_metric, atol=cfg.fed.tolerance):
-                termination_count -= 1
-                if termination_count == 0:
-                    if verbose:
-                        print("Early stopping triggered: No significant "
-                              "change in metrics for "
-                              f"{cfg.fed.termination_patience} rounds.",
-                              flush=True)
-                    stopped_early = True
-                    break
-            else:
-                prev_metric = cur
-                termination_count = cfg.fed.termination_patience
+                if jsonl is not None:
+                    jsonl.write(json.dumps({
+                        "round": r + 1, "sec_per_round": dt,
+                        "client_mean": client_mean,
+                        "pooled": {k: pooled_hist[k][-1] for k in METRIC_NAMES},
+                        "loss_mean": float(np.mean(losses[-1])),
+                    }) + "\n")
+                    jsonl.flush()
 
-        rnd += take
+                if verbose and (r % cfg.run.log_every == 0):
+                    print(f"\nRound {r + 1}:\n", flush=True)
+                    if cfg.run.log_per_client:
+                        # Parity with the barrier-serialized rank-ordered prints
+                        # (FL_CustomMLP...:151-162) — here just a loop, no barriers.
+                        for c in range(cfg.shard.num_clients):
+                            vals = ", ".join(f"{k}: {per_client[k][c]:.4f}"
+                                             for k in METRIC_NAMES)
+                            print(f"  CLIENT {c} - Local Metrics (Round {r + 1}): "
+                                  f"[{vals}]", flush=True)
+                    gvals = ", ".join(f"{k}: {client_mean[k]:.4f}"
+                                      for k in METRIC_NAMES)
+                    print(f"  Global Metrics (Round {r + 1}): [{gvals}]  "
+                          f"({dt * 1e3:.1f} ms/round)", flush=True)
 
-        if stopped_early:
-            # The chunk overshot the stop round; don't checkpoint or eval the
-            # overshoot state (the unchunked loop's `break` skips these too).
-            break
+                # Early stopping — exact reference logic (FL_CustomMLP...:181-192).
+                cur = [client_mean[k] for k in METRIC_NAMES]
+                if prev_metric is not None and np.allclose(
+                        cur, prev_metric, atol=cfg.fed.tolerance):
+                    termination_count -= 1
+                    if termination_count == 0:
+                        if verbose:
+                            print("Early stopping triggered: No significant "
+                                  "change in metrics for "
+                                  f"{cfg.fed.termination_patience} rounds.",
+                                  flush=True)
+                        stopped_early = True
+                        break
+                else:
+                    prev_metric = cur
+                    termination_count = cfg.fed.termination_patience
 
-        # Held-out eval / checkpoint at chunk boundaries when due within the
-        # chunk (with rounds_per_step=1 this is the exact per-round cadence).
-        # Every due round appends an entry so test_hist round-alignment
-        # matches the unchunked run; due rounds inside one chunk share the
-        # chunk-end global params (documented approximation).
-        if cfg.run.eval_test_every:
-            due = sum(1 for j in range(take)
-                      if (rnd - j) % cfg.run.eval_test_every == 0)
-            if due:
-                tm = eval_step(global_params(state), ds.x_test, ds.y_test)
-                for _ in range(due):
-                    for k in METRIC_NAMES:
-                        test_hist[k].append(float(tm[k]))
+            rnd += take
 
-        if ckpt_every and cfg.run.checkpoint_dir and any(
-                (rnd - j) % ckpt_every == 0 for j in range(take)):
-            save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd)
+            if stopped_early:
+                # The chunk overshot the stop round; don't checkpoint or eval the
+                # overshoot state (the unchunked loop's `break` skips these too).
+                break
+
+            # Held-out eval / checkpoint at chunk boundaries when due within the
+            # chunk (with rounds_per_step=1 this is the exact per-round cadence).
+            # Every due round appends an entry so test_hist round-alignment
+            # matches the unchunked run; due rounds inside one chunk share the
+            # chunk-end global params (documented approximation).
+            if cfg.run.eval_test_every:
+                due = sum(1 for j in range(take)
+                          if (rnd - j) % cfg.run.eval_test_every == 0)
+                if due:
+                    tm = eval_step(global_params(state), ds.x_test, ds.y_test)
+                    for _ in range(due):
+                        for k in METRIC_NAMES:
+                            test_hist[k].append(float(tm[k]))
+
+            if ckpt_every and cfg.run.checkpoint_dir and any(
+                    (rnd - j) % ckpt_every == 0 for j in range(take)):
+                save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd)
+
+    finally:
+        if cfg.run.profile_dir:
+            jax.block_until_ready(state["params"])
+            jax.profiler.stop_trace()
+        if jsonl is not None:
+            jsonl.close()
 
     return ExperimentResult(
         global_metrics=history,

@@ -30,7 +30,8 @@ import numpy as np
 
 from fedtpu.config import ExperimentConfig
 from fedtpu.data.sharding import shard_indices
-from fedtpu.data.tabular import load_tabular_dataset, Dataset
+from fedtpu.data import load_dataset
+from fedtpu.data.tabular import Dataset
 from fedtpu.ops.metrics import METRIC_NAMES
 
 
@@ -118,7 +119,7 @@ def run_parity_demo(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     sklearn_max_iter: int = 300,
                     verbose: bool = True) -> dict:
     """Parts A + B; returns both trajectories and the verdicts."""
-    ds = dataset or load_tabular_dataset(cfg.data)
+    ds = dataset or load_dataset(cfg.data)
 
     sk = run_sklearn_rounds(ds, cfg, max_iter=sklearn_max_iter,
                             verbose=verbose)

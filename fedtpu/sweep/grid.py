@@ -36,7 +36,8 @@ from jax.sharding import PartitionSpec as P
 
 from fedtpu.config import ExperimentConfig
 from fedtpu.data.sharding import pack_clients
-from fedtpu.data.tabular import load_tabular_dataset, Dataset
+from fedtpu.data import load_dataset
+from fedtpu.data.tabular import Dataset
 from fedtpu.models.mlp import mlp_init, mlp_apply
 from fedtpu.ops.losses import masked_cross_entropy
 from fedtpu.ops.metrics import confusion_matrix, metrics_from_confusion
@@ -105,7 +106,7 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     verbose: bool = True) -> dict:
     """Run the 90-config federated grid; returns the best-config summary
     (the reference's :126-132 printout, as data)."""
-    ds = dataset or load_tabular_dataset(cfg.data)
+    ds = dataset or load_dataset(cfg.data)
     mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
     shard = client_sharding(mesh)
     packed = pack_clients(ds.x_train, ds.y_train, cfg.shard)
