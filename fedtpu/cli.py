@@ -105,6 +105,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
     if args.participation_rate is not None:
         fed = dataclasses.replace(fed,
                                   participation_rate=args.participation_rate)
+    if getattr(args, "aggregation", None) is not None:
+        fed = dataclasses.replace(fed, aggregation=args.aggregation)
     run_kw = {}
     if args.checkpoint_dir is not None:
         run_kw["checkpoint_dir"] = args.checkpoint_dir
@@ -132,6 +134,13 @@ def main(argv=None) -> int:
 
     run_p = sub.add_parser("run", help="run a federated experiment")
     _add_common_overrides(run_p)
+    # run-only: the FedAvg parameter-averaging reduction backend. The sweep
+    # and parity programs use their own fixed psum reductions, so accepting
+    # the flag there would silently ignore it.
+    run_p.add_argument("--aggregation", choices=["psum", "ring", "ring-rsag"],
+                       default=None,
+                       help="FedAvg reduction backend (default psum; ring = "
+                            "explicit ppermute ICI ring)")
     run_p.add_argument("--resume", action="store_true",
                        help="resume from the latest checkpoint in "
                             "--checkpoint-dir")

@@ -51,6 +51,9 @@ class DataConfig:
     test_size: float = 0.2               # FL_CustomMLP...:239
     split_seed: int = 42                 # random_state=42 everywhere in the reference
     scale_with_mean: bool = True         # FL_SkLearn...:184 uses with_mean=False; torch driver uses default True
+    # CSV parse + label-encode via the C++ loader (fedtpu.native), falling
+    # back to pandas when no toolchain is available. Parity-tested identical.
+    native_loader: bool = True
     # The reference fits the scaler on the FULL dataset before splitting
     # (FL_CustomMLP...:235-236) — train/test leakage. Parity default keeps it;
     # set False for the clean fit-on-train-only pipeline.
@@ -128,6 +131,15 @@ class FedConfig:
     # client trains every round. See fedtpu.parallel.round.
     participation_rate: float = 1.0
     participation_seed: int = 0
+    # Reduction backend for the PARAMETER-AVERAGING path (the FedAvg
+    # weighted sum + total-weight reduction): 'psum' (XLA-scheduled
+    # collective, production) | 'ring' (explicit ppermute rotate-accumulate)
+    # | 'ring-rsag' (explicit reduce-scatter + all-gather). Metric pooling
+    # (confusion matrices) always uses psum — it feeds replicated host
+    # output, not the averaging path. See fedtpu.parallel.ring for why the
+    # ring is the ICI-native answer to the reference's rank-0
+    # gather/average/bcast (FL_CustomMLP...:101-120).
+    aggregation: str = "psum"
     # Each client starts from an independent random init, matching the
     # reference where every rank constructs an unseeded torch model
     # (FL_CustomMLP...:42). Set True to start all clients identical.

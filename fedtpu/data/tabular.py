@@ -85,6 +85,26 @@ def _train_test_split(x, y, test_size: float, seed: int):
     return train_test_split(x, y, test_size=test_size, random_state=seed)
 
 
+def _load_encoded(csv_path: str, use_native: bool):
+    """Load + label-encode a CSV: ``(column_names, float64 matrix, classes)``
+    where object columns in the matrix already hold sorted-unique codes.
+
+    Primary path is the native C++ loader (fedtpu.native — one parse pass,
+    the host-runtime replacement for the reference's per-rank pandas +
+    LabelEncoder preamble, FL_CustomMLP...:216-230); pandas is the fallback
+    when no toolchain is available. A parity test pins both to identical
+    output on the shipped income CSV; see csv_loader.cpp for the known
+    inference divergences on exotic inputs (pandas NA tokens)."""
+    if use_native:
+        from fedtpu import native
+        if native.available():
+            header, _, mat, classes = native.load_csv(csv_path)
+            return list(header), mat, classes
+    df = pd.read_csv(csv_path)
+    encoders = _label_encode(df)
+    return list(df.columns), df.to_numpy(dtype=np.float64), encoders
+
+
 def synthetic_income_like(rows: int, features: int, classes: int,
                           seed: int = 7):
     """A balanced, linearly-separable-ish stand-in for
@@ -105,15 +125,16 @@ def load_tabular_dataset(cfg: DataConfig) -> Dataset:
         label_classes = np.arange(cfg.synthetic_classes)
         feature_names = tuple(f"f{i}" for i in range(x.shape[1]))
     else:
-        df = pd.read_csv(cfg.csv_path)
-        if cfg.label_column not in df.columns:
+        loaded = _load_encoded(cfg.csv_path, cfg.native_loader)
+        columns, mat, encoders = loaded
+        if cfg.label_column not in columns:
             # Same guard as FL_CustomMLP...:219-220.
             raise KeyError(
                 f"'{cfg.label_column}' not found in dataset columns. "
-                f"Available columns: {df.columns.tolist()}")
-        encoders = _label_encode(df)
-        y = df[cfg.label_column].to_numpy()
-        x = df.drop(columns=[cfg.label_column]).to_numpy(dtype=np.float64)
+                f"Available columns: {list(columns)}")
+        li = columns.index(cfg.label_column)
+        y = mat[:, li]
+        x = np.delete(mat, li, axis=1)
         # Re-encode labels to contiguous 0..K-1 class indices regardless of
         # source dtype: numeric label columns (e.g. the diabetes 'Outcome'
         # path, FL_CustomMLP...:217) bypass _label_encode, and raw values like
@@ -121,7 +142,7 @@ def load_tabular_dataset(cfg: DataConfig) -> Dataset:
         # silently clamping in the loss and falling off the confusion matrix.
         original_classes, y = np.unique(y, return_inverse=True)
         label_classes = encoders.get(cfg.label_column, original_classes)
-        feature_names = tuple(c for c in df.columns if c != cfg.label_column)
+        feature_names = tuple(c for c in columns if c != cfg.label_column)
 
     num_classes = int(len(np.unique(y)))
 

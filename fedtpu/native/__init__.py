@@ -1,0 +1,108 @@
+"""Native (C++) host runtime for fedtpu: the CSV loader / label encoder.
+
+The compute path is JAX/XLA; the host runtime around it is native where the
+work is host-bound. ``load_csv`` is the C++ replacement for the
+pandas.read_csv + per-column LabelEncoder preamble every reference rank runs
+(FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:216-230): one parse
+pass, type inference, and sorted-unique label encoding behind a C ABI.
+
+Bindings are ctypes (pybind11 is not in the image); the shared object is
+compiled on first use by :mod:`fedtpu.native.build` and cached next to the
+source. ``available()`` is False when no C++ toolchain exists — callers
+(fedtpu.data.tabular) fall back to the pandas path, which a parity test
+pins to identical output.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from fedtpu.native.build import ensure_built
+
+_lib = None
+_lib_failed = False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.csv_open.argtypes = [ctypes.c_char_p]
+    lib.csv_open.restype = ctypes.c_void_p
+    lib.csv_error.argtypes = [ctypes.c_void_p]
+    lib.csv_error.restype = ctypes.c_char_p
+    lib.csv_rows.argtypes = [ctypes.c_void_p]
+    lib.csv_rows.restype = ctypes.c_int64
+    lib.csv_cols.argtypes = [ctypes.c_void_p]
+    lib.csv_cols.restype = ctypes.c_int64
+    lib.csv_col_is_numeric.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.csv_col_is_numeric.restype = ctypes.c_int
+    lib.csv_fill.argtypes = [ctypes.c_void_p,
+                             np.ctypeslib.ndpointer(np.float64, flags="C")]
+    lib.csv_fill.restype = None
+    lib.csv_header.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int64]
+    lib.csv_header.restype = ctypes.c_int64
+    lib.csv_col_classes.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.c_char_p, ctypes.c_int64]
+    lib.csv_col_classes.restype = ctypes.c_int64
+    lib.csv_close.argtypes = [ctypes.c_void_p]
+    lib.csv_close.restype = None
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is None and not _lib_failed:
+        so = ensure_built()
+        if so is None:
+            _lib_failed = True
+        else:
+            _lib = _bind(ctypes.CDLL(str(so)))
+    return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+def _read_strings(fn, *args) -> list:
+    """Read a NUL-delimited string list over the C ABI (cells may contain
+    newlines via quoted fields, so '\\n' cannot delimit)."""
+    n = int(fn(*args, None, 0))
+    if n == 0:
+        return [""]
+    buf = ctypes.create_string_buffer(n)
+    fn(*args, buf, n)
+    return [part.decode("utf-8") for part in buf.raw[:n].split(b"\x00")]
+
+
+def load_csv(path: str) -> Tuple[Tuple[str, ...], np.ndarray,
+                                 np.ndarray, Dict[str, np.ndarray]]:
+    """Parse ``path`` natively. Returns ``(header, numeric_mask, matrix,
+    classes)``: matrix is float64 row-major with categorical columns already
+    label-encoded; classes maps each categorical column name to its sorted
+    unique original values (LabelEncoder ``classes_``)."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native CSV loader unavailable (no C++ toolchain)")
+    h = lib.csv_open(path.encode("utf-8"))
+    try:
+        err = lib.csv_error(h)
+        if err:
+            raise ValueError(f"native CSV parse of {path!r}: "
+                             f"{err.decode('utf-8')}")
+        rows, cols = lib.csv_rows(h), lib.csv_cols(h)
+        header = tuple(_read_strings(lib.csv_header, h))
+        numeric = np.array([bool(lib.csv_col_is_numeric(h, c))
+                            for c in range(cols)])
+        mat = np.empty((rows, cols), np.float64)
+        lib.csv_fill(h, mat)
+        classes = {}
+        for c in range(cols):
+            if not numeric[c]:
+                vals = _read_strings(lib.csv_col_classes, h, c)
+                classes[header[c]] = np.array(vals, dtype=object)
+        return header, numeric, mat, classes
+    finally:
+        lib.csv_close(h)

@@ -1,0 +1,45 @@
+"""Compile-on-first-use for the native loader: g++ -O2 -shared -fPIC, cached
+next to the source, rebuilt when the source is newer than the .so. No build
+system required at install time; no toolchain required at run time (callers
+check ``available()`` and fall back)."""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = pathlib.Path(__file__).resolve().parent
+_SRC = _HERE / "csv_loader.cpp"
+_SO = _HERE / "_fastcsv.so"
+_lock = threading.Lock()
+
+
+def ensure_built(verbose: bool = False) -> Optional[pathlib.Path]:
+    """Return the shared-object path, compiling if stale; None if impossible."""
+    with _lock:
+        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+            return _SO
+        cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+        if cxx is None:
+            return None
+        # Compile to a process-unique temp name, then rename atomically:
+        # a concurrent process must never dlopen a half-written .so.
+        tmp = _SO.with_suffix(f".so.tmp{os.getpid()}")
+        cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+               str(_SRC), "-o", str(tmp)]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            if verbose:
+                print(f"[fedtpu.native] build failed:\n{proc.stderr}")
+            tmp.unlink(missing_ok=True)
+            return None
+        os.replace(tmp, _SO)
+        return _SO

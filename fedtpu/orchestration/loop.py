@@ -140,7 +140,8 @@ def build_experiment(cfg: ExperimentConfig,
                               weighting=cfg.fed.weighting,
                               rounds_per_step=rounds_per_step,
                               participation_rate=cfg.fed.participation_rate,
-                              participation_seed=cfg.fed.participation_seed)
+                              participation_seed=cfg.fed.participation_seed,
+                              aggregation=cfg.fed.aggregation)
 
     eval_step = build_eval_fn(eval_apply, ds.num_classes)
     return Experiment(make_step=make_step, state=state, batch=batch,

@@ -1,0 +1,130 @@
+"""Ring all-reduce over the clients mesh axis — the ICI-native analogue of
+the reference's gather -> average -> bcast cycle.
+
+The reference funnels every client's weights through rank 0
+(FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py:101-120): N-1
+pickled point-to-point sends in, one weighted average, N-1 sends out — rank
+0's NIC is the bottleneck and the payload crosses the host. On a TPU ring
+(ICI is a physical torus) the same reduction is N-1 *neighbor* hops with
+every link busy every step, and the bytes never leave device memory.
+
+Production fedtpu uses ``jax.lax.psum`` and lets XLA pick the collective
+algorithm (on TPU it lowers to exactly these ring/torus schedules, fused and
+double-buffered). This module spells the schedule out with
+``jax.lax.ppermute`` — each ppermute is one neighbor ICI hop — both as the
+educational counterpart to the reference's rank-0 funnel and as a selectable
+aggregation backend (``FedConfig.aggregation = "ring"``), testable against
+psum on the virtual multi-device CPU mesh.
+
+Two schedules:
+
+- ``ring_all_reduce_sum``: rotate-and-accumulate. N-1 hops, each moving the
+  FULL payload: time ~ (N-1) * B / link_bw. Simplest correct ring.
+- ``ring_all_reduce_sum_rsag``: reduce-scatter + all-gather, the
+  bandwidth-optimal schedule (the one NCCL/XLA actually use): 2(N-1) hops,
+  each moving B/N bytes: time ~ 2(N-1)/N * B / link_bw — ~2x better at
+  N=8, asymptotically 2x/(N-1) less traffic than rotate-accumulate.
+
+Both must be called inside ``shard_map`` over ``axis_name``; both return the
+global sum with clients-varying typing on every shard. Float ordering
+caveats: both schedules sum in ring order, so expect ~1e-7 reassociation
+differences vs psum. Additionally, rotate-accumulate's association order is
+DIFFERENT on each shard (shard d computes x_d + x_{d-1} + ...), so its
+per-shard results differ bitwise from each other at the same magnitude —
+don't build bitwise cross-shard replication checks on ``"ring"``. ``rsag``
+is free of this: each chunk's sum is produced once on its owner and gathered
+verbatim, so all shards hold bitwise-identical results.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _right_perm(n: int):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_all_reduce_sum(x: jax.Array, axis_name: str, axis_size: int):
+    """Rotate-and-accumulate ring all-reduce: after N-1 neighbor hops every
+    shard holds ``sum_i x_i``."""
+    if axis_size == 1:
+        return x
+    perm = _right_perm(axis_size)
+
+    def hop(carry, _):
+        acc, rot = carry
+        rot = jax.lax.ppermute(rot, axis_name, perm)
+        return (acc + rot, rot), None
+
+    (acc, _), _ = jax.lax.scan(hop, (x, x), length=axis_size - 1)
+    return acc
+
+
+def ring_all_reduce_sum_rsag(x: jax.Array, axis_name: str, axis_size: int):
+    """Bandwidth-optimal ring all-reduce: reduce-scatter (N-1 hops, each
+    shard ends owning the full sum of one 1/N chunk) then all-gather
+    (N-1 hops to replicate the chunks). Payload is chunked along the
+    flattened leaf, zero-padded to a multiple of N."""
+    n = axis_size
+    if n == 1:
+        return x
+    shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunks = flat.reshape(n, -1)                     # (n, B/n)
+    me = jax.lax.axis_index(axis_name)
+    perm = _right_perm(n)
+
+    # Reduce-scatter: at step s, send the running sum of chunk (me - s),
+    # receive chunk (me - s - 1) from the left and fold ours in. After N-1
+    # steps this shard owns the COMPLETE sum of chunk (me + 1) % n.
+    def rs_hop(sending, s):
+        received = jax.lax.ppermute(sending, axis_name, perm)
+        idx = (me - s - 1) % n
+        return received + jax.lax.dynamic_index_in_dim(
+            chunks, idx, keepdims=False), None
+
+    start = jax.lax.dynamic_index_in_dim(chunks, me, keepdims=False)
+    owned, _ = jax.lax.scan(rs_hop, start, jnp.arange(n - 1))
+    owned_idx = (me + 1) % n
+
+    # All-gather: rotate the owned chunks around the ring, writing each into
+    # its slot. After N-1 hops every shard has every summed chunk.
+    out = jnp.zeros_like(chunks)
+    out = jax.lax.dynamic_update_index_in_dim(out, owned, owned_idx, 0)
+
+    def ag_hop(carry, s):
+        out, rot = carry
+        rot = jax.lax.ppermute(rot, axis_name, perm)
+        idx = (owned_idx - s - 1) % n
+        out = jax.lax.dynamic_update_index_in_dim(out, rot, idx, 0)
+        return (out, rot), None
+
+    (out, _), _ = jax.lax.scan(ag_hop, (out, owned), jnp.arange(n - 1))
+    full = out.reshape(-1)
+    if pad:
+        full = full[:-pad]
+    return full.reshape(shape)
+
+
+def make_all_reduce(kind: str, axis_name: str, axis_size: int):
+    """Reduction backend for the round program: ``psum`` (production, XLA
+    schedules it), ``ring`` (explicit rotate-accumulate), or ``ring-rsag``
+    (explicit reduce-scatter + all-gather). All return clients-varying sums."""
+    if kind == "psum":
+        def ar(v):
+            return jax.lax.pcast(jax.lax.psum(v, axis_name), axis_name,
+                                 to="varying")
+    elif kind == "ring":
+        def ar(v):
+            return ring_all_reduce_sum(v, axis_name, axis_size)
+    elif kind == "ring-rsag":
+        def ar(v):
+            return ring_all_reduce_sum_rsag(v, axis_name, axis_size)
+    else:
+        raise ValueError(f"unknown aggregation kind: {kind!r}")
+    return ar

@@ -37,6 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from fedtpu.ops.metrics import confusion_matrix, metrics_from_confusion
 from fedtpu.parallel.mesh import CLIENTS_AXIS, client_sharding
+from fedtpu.parallel.ring import make_all_reduce
 from fedtpu.training.client import make_local_train_step, make_local_eval_step
 
 
@@ -69,7 +70,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                    num_classes: int, weighting: str = "data_size",
                    rounds_per_step: int = 1,
                    participation_rate: float = 1.0,
-                   participation_seed: int = 0):
+                   participation_seed: int = 0,
+                   aggregation: str = "psum"):
     """Compile the full federated round. Returns
     ``round_step(state, batch) -> (state, metrics)`` where ``batch`` is a dict
     of client-sharded arrays ``x (C,N,...), y (C,N), mask (C,N)`` and
@@ -99,6 +101,13 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     local_eval = make_local_eval_step(apply_fn, num_classes)
 
     sampling = participation_rate < 1.0
+    # Reduction backend for the parameter-averaging path: psum
+    # (XLA-scheduled, production) or an explicit ppermute ring
+    # (fedtpu.parallel.ring) — the ICI-native analogue of the reference's
+    # rank-0 gather/average/bcast (FL_CustomMLP...:101-120). Metric pooling
+    # below stays on psum (replicated host output, not the averaging path).
+    n_devices = mesh.devices.size
+    all_reduce = make_all_reduce(aggregation, CLIENTS_AXIS, n_devices)
 
     def round_body(params, opt_state, x, y, mask, rnd):
         # Shapes here are per-device blocks: leading axis Cb = C / n_devices.
@@ -140,24 +149,18 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 w = base_w
 
             conf = jax.vmap(local_eval)(params, x, y, mask)   # (Cb, K, K)
-            total_w = jax.lax.psum(w.sum(), CLIENTS_AXIS)
+            total_w = all_reduce(w.sum())                     # clients-varying
 
             def avg(p):
-                # sum_i w_i * p_i locally, then psum across devices == the
-                # rank-0 gather + weighted average + bcast of
+                # sum_i w_i * p_i locally, then all-reduce across devices ==
+                # the rank-0 gather + weighted average + bcast of
                 # FL_CustomMLP...:105-119.
                 local = jnp.tensordot(w.astype(jnp.float32),
                                       p.astype(jnp.float32), axes=1)
-                glob = (jax.lax.psum(local, CLIENTS_AXIS)
-                        / jnp.maximum(total_w, 1.0))
+                glob = all_reduce(local) / jnp.maximum(total_w, 1.0)
                 out = jnp.broadcast_to(glob[None], p.shape).astype(p.dtype)
-                # psum output is replicated-typed; re-mark as clients-varying
-                # so it can mix with per-client params and match the scan
-                # carry type.
-                out = jax.lax.pcast(out, CLIENTS_AXIS, to="varying")
                 # Zero participants (possible under sampling): skip averaging.
-                return jnp.where(jax.lax.pcast(total_w > 0, CLIENTS_AXIS, to="varying"),
-                                 out, p)
+                return jnp.where(total_w > 0, out, p)
 
             params = jax.tree.map(avg, params)
             pooled_conf = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)
