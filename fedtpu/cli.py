@@ -122,6 +122,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["metrics_jsonl"] = args.metrics_jsonl
     if args.log_per_client:
         run_kw["log_per_client"] = True
+    if getattr(args, "model_parallel", None) is not None:
+        run_kw["model_parallel"] = args.model_parallel
     if run_kw:
         run = dataclasses.replace(run, **run_kw)
     return ExperimentConfig(data=data, shard=shard, model=model, optim=optim,
@@ -141,6 +143,10 @@ def main(argv=None) -> int:
                        default=None,
                        help="FedAvg reduction backend (default psum; ring = "
                             "explicit ppermute ICI ring)")
+    run_p.add_argument("--model-parallel", type=int, default=None,
+                       help=">1 selects the 2-D ('clients','model') GSPMD "
+                            "engine: hidden weights shard over a tensor-"
+                            "parallel axis of this extent (MLP only)")
     run_p.add_argument("--resume", action="store_true",
                        help="resume from the latest checkpoint in "
                             "--checkpoint-dir")

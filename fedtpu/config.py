@@ -166,6 +166,10 @@ class RunConfig:
     profile_dir: Optional[str] = None    # jax.profiler trace of the round loop
     metrics_jsonl: Optional[str] = None  # append one JSON line per round
     mesh_devices: int = 0                # 0 = all visible devices
+    # >1 selects the 2-D ('clients','model') GSPMD engine
+    # (fedtpu.parallel.tp): hidden weights shard over a tensor-parallel axis
+    # of this extent. MLP only; partial participation unsupported there.
+    model_parallel: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

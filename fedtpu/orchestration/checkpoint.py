@@ -59,8 +59,12 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
 
     ``state_like``: a live state pytree (e.g. a freshly-initialized one from
     ``init_federated_state``) used as the restore template so container types
-    (optax namedtuples) survive the roundtrip. ``sharding``: re-lay-out the
-    client-axis leaves onto the mesh.
+    (optax namedtuples) survive the roundtrip; when its leaves are committed
+    jax Arrays, each restored leaf is placed on the SAME per-leaf sharding —
+    this is what preserves the tensor-parallel layout of the 2-D engine
+    (fedtpu.parallel.tp), where params mix clients-only and
+    clients+model-sharded leaves. ``sharding``: fallback single layout for
+    all non-scalar leaves when ``state_like`` carries no shardings.
     """
     if step is None:
         step = latest_step(directory)
@@ -71,7 +75,20 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
     template = to_numpy(state_like) if state_like is not None else None
     state = ckptr.restore(os.path.join(path, "state"), item=template)
     meta = ckptr.restore(os.path.join(path, "meta"))
-    if sharding is not None:
+    def _mesh_sharding(like):
+        s = getattr(like, "sharding", None)
+        return s if isinstance(s, jax.sharding.NamedSharding) else None
+
+    if state_like is not None and any(
+            _mesh_sharding(l) is not None for l in jax.tree.leaves(state_like)):
+        # Mesh-laid-out leaves reuse their template sharding; scalars (the
+        # round counter) stay uncommitted so jit can place them freely.
+        state = jax.tree.map(
+            lambda l, like: (jax.device_put(l, _mesh_sharding(like))
+                             if _mesh_sharding(like) is not None
+                             else jax.device_put(l)),
+            state, state_like)
+    elif sharding is not None:
         # Every non-scalar state leaf carries the leading clients axis
         # (params, Adam moments); scalars (the round counter, Adam counts of
         # shape (C,) stay client-sharded too since ndim >= 1).

@@ -109,21 +109,57 @@ def build_experiment(cfg: ExperimentConfig,
     if model_cfg.num_classes != ds.num_classes:
         model_cfg = dataclasses.replace(model_cfg, num_classes=ds.num_classes)
 
-    mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
     init_fn, apply_fn = build_model(model_cfg)
     tx = build_optimizer(cfg.optim)
-
     packed = pack_clients(ds.x_train, ds.y_train, cfg.shard)
-    shard = client_sharding(mesh)
+
+    if cfg.run.model_parallel > 1:
+        # 2-D ('clients','model') GSPMD engine (fedtpu.parallel.tp).
+        from fedtpu.parallel import tp
+        if model_cfg.kind != "mlp":
+            raise ValueError("model_parallel > 1 supports the MLP family only")
+        if cfg.fed.participation_rate < 1.0:
+            raise ValueError("partial participation requires the 1-D engine "
+                             "(model_parallel=1)")
+        if cfg.fed.aggregation != "psum":
+            raise ValueError("explicit ring aggregation requires the 1-D "
+                             "engine (model_parallel=1); the 2-D engine's "
+                             "collectives are GSPMD-chosen")
+        bad = [h for h in model_cfg.hidden_sizes
+               if h % cfg.run.model_parallel]
+        if bad:
+            raise ValueError(
+                f"hidden sizes {bad} not divisible by "
+                f"model_parallel={cfg.run.model_parallel}; uneven shards "
+                "would silently pad and imbalance memory/compute")
+        mesh = tp.make_mesh_2d(cfg.run.model_parallel, cfg.shard.num_clients,
+                               cfg.run.mesh_devices)
+        shard = tp.batch_sharding_2d(mesh)
+        state_fn = lambda: tp.init_federated_state_2d(
+            jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
+            init_fn, tx, same_init=cfg.fed.same_init)
+        step_fn = lambda r: tp.build_round_fn_2d(
+            mesh, apply_fn, tx, ds.num_classes, weighting=cfg.fed.weighting,
+            rounds_per_step=r)
+    else:
+        mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
+        shard = client_sharding(mesh)
+        state_fn = lambda: init_federated_state(
+            jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
+            init_fn, tx, same_init=cfg.fed.same_init)
+        step_fn = lambda r: build_round_fn(
+            mesh, apply_fn, tx, ds.num_classes, weighting=cfg.fed.weighting,
+            rounds_per_step=r,
+            participation_rate=cfg.fed.participation_rate,
+            participation_seed=cfg.fed.participation_seed,
+            aggregation=cfg.fed.aggregation)
+
     batch = {
         "x": jax.device_put(packed.x, shard),
         "y": jax.device_put(packed.y, shard),
         "mask": jax.device_put(packed.mask, shard),
     }
-
-    state = init_federated_state(
-        jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
-        init_fn, tx, same_init=cfg.fed.same_init)
+    state = state_fn()
 
     # Opt-in Pallas fused forward for the held-out eval (a plain jit, outside
     # shard_map; the in-round eval stays on the XLA path, which shard_map's
@@ -135,16 +171,8 @@ def build_experiment(cfg: ExperimentConfig,
         from fedtpu.ops.pallas_kernels import fused_mlp_forward
         eval_apply = fused_mlp_forward
 
-    def make_step(rounds_per_step: int = 1):
-        return build_round_fn(mesh, apply_fn, tx, ds.num_classes,
-                              weighting=cfg.fed.weighting,
-                              rounds_per_step=rounds_per_step,
-                              participation_rate=cfg.fed.participation_rate,
-                              participation_seed=cfg.fed.participation_seed,
-                              aggregation=cfg.fed.aggregation)
-
     eval_step = build_eval_fn(eval_apply, ds.num_classes)
-    return Experiment(make_step=make_step, state=state, batch=batch,
+    return Experiment(make_step=step_fn, state=state, batch=batch,
                       eval_step=eval_step, dataset=ds, mesh=mesh)
 
 
@@ -171,9 +199,10 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     if resume and cfg.run.checkpoint_dir:
         from fedtpu.orchestration.checkpoint import latest_step, load_checkpoint
         if latest_step(cfg.run.checkpoint_dir) is not None:
+            # Per-leaf shardings come from the live state template, so the
+            # 2-D engine's tensor-parallel layout survives resume.
             state, restored_history, start_round = load_checkpoint(
-                cfg.run.checkpoint_dir, sharding=client_sharding(exp.mesh),
-                state_like=state)
+                cfg.run.checkpoint_dir, state_like=state)
             if verbose:
                 print(f"Resumed from checkpoint at round {start_round}.",
                       flush=True)

@@ -24,19 +24,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 CLIENTS_AXIS = "clients"
 
 
+def trim_to_divisor(n: int, num_clients: int) -> int:
+    """Largest extent <= n that divides num_clients (so the client axis
+    block-distributes evenly); n unchanged when num_clients == 0."""
+    if num_clients:
+        while num_clients % n:
+            n -= 1
+    return n
+
+
 def make_mesh(num_devices: int = 0, num_clients: int = 0) -> Mesh:
     """Build a 1-D ('clients',) mesh.
 
     num_devices=0 uses every visible device; if ``num_clients`` is given, the
-    device count is trimmed to the largest divisor of num_clients so the
-    client axis block-distributes evenly.
+    device count is trimmed to the largest divisor of num_clients.
     """
     devices = jax.devices()
-    n = num_devices or len(devices)
-    n = min(n, len(devices))
-    if num_clients:
-        while num_clients % n:
-            n -= 1
+    n = trim_to_divisor(min(num_devices or len(devices), len(devices)),
+                        num_clients)
     return Mesh(np.asarray(devices[:n]), (CLIENTS_AXIS,))
 
 

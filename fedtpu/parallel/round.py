@@ -41,6 +41,16 @@ from fedtpu.parallel.ring import make_all_reduce
 from fedtpu.training.client import make_local_train_step, make_local_eval_step
 
 
+def client_init_keys(key: jax.Array, num_clients: int, same_init: bool):
+    """Per-client PRNG keys: identical when ``same_init`` (all clients start
+    from one model), else split — the reproducible stand-in for the
+    reference's unseeded per-rank torch init (FL_CustomMLP...:42). Shared by
+    both engines (this module and fedtpu.parallel.tp)."""
+    if same_init:
+        return jnp.broadcast_to(key, (num_clients, *key.shape))
+    return jax.random.split(key, num_clients)
+
+
 def init_federated_state(key: jax.Array, mesh, num_clients: int,
                          init_fn: Callable, tx: optax.GradientTransformation,
                          same_init: bool = False):
@@ -51,11 +61,7 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
     ranks differ); here each client folds its index into the key instead, so
     the "different inits" are still reproducible.
     """
-    if same_init:
-        keys = jnp.broadcast_to(key, (num_clients, *key.shape))
-    else:
-        keys = jax.random.split(key, num_clients)
-    params = jax.vmap(init_fn)(keys)
+    params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
     opt_state = jax.vmap(tx.init)(params)
     shard = client_sharding(mesh)
     put = lambda t: jax.device_put(t, shard)
@@ -184,30 +190,38 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
         params, opt_state, loss, conf, pooled_conf = sharded_body(
             state["params"], state["opt_state"],
             batch["x"], batch["y"], batch["mask"], state["round"])
-        # conf: (R, C, K, K) -> per-round, per-client metric dicts.
-        per_client = jax.vmap(jax.vmap(metrics_from_confusion))(conf)
-        # Empty shards (possible under dirichlet skew or clients > samples)
-        # report all-zero metrics; exclude them from the client mean so one
-        # dataless client doesn't deflate the global metric / early-stop
-        # signal. (The reference's sklearn scripts likewise skip dataless
-        # ranks, FL_SkLearn...:91-93.)
-        nonempty = (batch["mask"].sum(axis=1) > 0).astype(jnp.float32)
-        denom = jnp.maximum(nonempty.sum(), 1.0)
-        metrics = {
-            "loss": loss,
-            "per_client": per_client,
-            "client_mean": jax.tree.map(
-                lambda v: (v * nonempty[None, :]).sum(axis=1) / denom,
-                per_client),
-            "pooled": jax.vmap(metrics_from_confusion)(pooled_conf),
-        }
-        if rounds_per_step == 1:
-            metrics = jax.tree.map(lambda v: v[0], metrics)
+        metrics = assemble_metrics(loss, conf, pooled_conf, batch["mask"],
+                                   rounds_per_step)
         new_state = {"params": params, "opt_state": opt_state,
                      "round": state["round"] + rounds_per_step}
         return new_state, metrics
 
     return round_step
+
+
+def assemble_metrics(loss, conf, pooled_conf, mask, rounds_per_step: int):
+    """Per-round metric dicts from stacked confusion matrices; shared by the
+    shard_map engine above and the GSPMD 2-D engine (fedtpu.parallel.tp).
+
+    ``conf``: (R, C, K, K). Empty shards (possible under dirichlet skew or
+    clients > samples) report all-zero metrics; they are excluded from the
+    client mean so one dataless client doesn't deflate the global metric /
+    early-stop signal. (The reference's sklearn scripts likewise skip
+    dataless ranks, FL_SkLearn...:91-93.)"""
+    per_client = jax.vmap(jax.vmap(metrics_from_confusion))(conf)
+    nonempty = (mask.sum(axis=1) > 0).astype(jnp.float32)
+    denom = jnp.maximum(nonempty.sum(), 1.0)
+    metrics = {
+        "loss": loss,
+        "per_client": per_client,
+        "client_mean": jax.tree.map(
+            lambda v: (v * nonempty[None, :]).sum(axis=1) / denom,
+            per_client),
+        "pooled": jax.vmap(metrics_from_confusion)(pooled_conf),
+    }
+    if rounds_per_step == 1:
+        metrics = jax.tree.map(lambda v: v[0], metrics)
+    return metrics
 
 
 def global_params(state):
