@@ -166,6 +166,11 @@ class RunConfig:
     profile_dir: Optional[str] = None    # jax.profiler trace of the round loop
     metrics_jsonl: Optional[str] = None  # append one JSON line per round
     mesh_devices: int = 0                # 0 = all visible devices
+    # Failure detection (SURVEY.md §5: the reference's only failure handling
+    # is a blanket `except -> comm.Abort()`, FL_CustomMLP...:203-205): halt
+    # the round loop cleanly when loss or metrics go non-finite (diverged
+    # run, bad lr), writing an emergency checkpoint if checkpoint_dir is set.
+    halt_on_nonfinite: bool = True
     # >1 selects the 2-D ('clients','model') GSPMD engine
     # (fedtpu.parallel.tp): hidden weights shard over a tensor-parallel axis
     # of this extent. MLP only; partial participation unsupported there.

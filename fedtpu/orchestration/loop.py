@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -44,6 +45,7 @@ from fedtpu.data.tabular import Dataset
 from fedtpu.models import build_model
 from fedtpu.ops import build_optimizer
 from fedtpu.ops.metrics import METRIC_NAMES
+from fedtpu.orchestration.checkpoint import save_checkpoint
 from fedtpu.parallel.mesh import make_mesh, client_sharding
 from fedtpu.parallel.round import (build_round_fn, build_eval_fn,
                                    init_federated_state, global_params)
@@ -71,6 +73,8 @@ class ExperimentResult:
     stopped_early: bool
     final_params: dict
     config: ExperimentConfig
+    # True when the non-finite guard (RunConfig.halt_on_nonfinite) fired.
+    diverged: bool = False
 
     def summary(self) -> dict:
         last = {k: v[-1] for k, v in self.global_metrics.items() if v}
@@ -82,6 +86,7 @@ class ExperimentResult:
         return {
             "rounds_run": self.rounds_run,
             "stopped_early": self.stopped_early,
+            "diverged": self.diverged,
             "final_global_metrics": last,
             "mean_sec_per_round": float(np.mean(steady)),
         }
@@ -218,6 +223,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     prev_metric = None
     termination_count = cfg.fed.termination_patience
     stopped_early = False
+    diverged = False
     rounds_run = 0
 
     if restored_history is not None:
@@ -228,9 +234,6 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         rounds_run = start_round
 
     ckpt_every = cfg.run.checkpoint_every
-    if ckpt_every and cfg.run.checkpoint_dir:
-        from fedtpu.orchestration.checkpoint import save_checkpoint
-
     chunk = max(1, cfg.run.rounds_per_step)
     step_fns: Dict[int, Callable] = {}
 
@@ -294,8 +297,30 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     print(f"  Global Metrics (Round {r + 1}): [{gvals}]  "
                           f"({dt * 1e3:.1f} ms/round)", flush=True)
 
-                # Early stopping — exact reference logic (FL_CustomMLP...:181-192).
+                # Failure detection: a diverged step (NaN/inf loss or
+                # metrics) halts cleanly instead of burning the remaining
+                # rounds — with an emergency checkpoint of the last state.
                 cur = [client_mean[k] for k in METRIC_NAMES]
+                if cfg.run.halt_on_nonfinite and not (
+                        np.all(np.isfinite(cur))
+                        and np.all(np.isfinite(losses[-1]))):
+                    if verbose:
+                        print(f"Non-finite loss/metrics at round {r + 1}; "
+                              "halting (diverged run).", flush=True)
+                    if cfg.run.checkpoint_dir:
+                        # Quarantined under diverged/ so latest_step() — and
+                        # therefore resume — still finds the last GOOD
+                        # periodic checkpoint, not the poisoned state. The
+                        # saved state is the chunk-end state (round
+                        # rnd + take under chunking), labeled as such.
+                        save_checkpoint(
+                            os.path.join(cfg.run.checkpoint_dir, "diverged"),
+                            state, history, rnd + take)
+                    stopped_early = True
+                    diverged = True
+                    break
+
+                # Early stopping — exact reference logic (FL_CustomMLP...:181-192).
                 if prev_metric is not None and np.allclose(
                         cur, prev_metric, atol=cfg.fed.tolerance):
                     termination_count -= 1
@@ -354,4 +379,5 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         stopped_early=stopped_early,
         final_params=to_numpy(global_params(state)),
         config=cfg,
+        diverged=diverged,
     )

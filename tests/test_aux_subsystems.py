@@ -45,6 +45,27 @@ def test_profiler_trace_produced(tmp_path):
     assert found, "no profiler output written"
 
 
+def test_nonfinite_guard_halts_diverged_run(tmp_path):
+    from fedtpu.config import OptimConfig
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=128),
+        shard=ShardConfig(num_clients=8),
+        # An absurd learning rate reliably drives the loss to NaN.
+        optim=OptimConfig(learning_rate=1e18),
+        fed=FedConfig(rounds=50),
+        run=RunConfig(checkpoint_dir=str(tmp_path / "ck")),
+    )
+    res = run_experiment(cfg, verbose=False)
+    assert res.diverged and res.stopped_early
+    assert res.summary()["diverged"] is True
+    assert res.rounds_run < 50
+    from fedtpu.orchestration.checkpoint import latest_step
+    # The poisoned state is quarantined under diverged/ — resume must NOT
+    # see it as the latest periodic checkpoint.
+    assert latest_step(str(tmp_path / "ck")) is None
+    assert latest_step(str(tmp_path / "ck" / "diverged")) == res.rounds_run
+
+
 def test_cifar10_synthetic_fallback_shapes():
     ds = load_cifar10(root="/nonexistent", synthetic_rows=100)
     assert ds.x_train.shape == (80, 32 * 32 * 3)
