@@ -34,3 +34,30 @@ from fedtpu.config import (  # noqa: F401
     PRESETS,
     get_preset,
 )
+
+_LAZY = {
+    # Heavyweight entry points resolved on first access (PEP 562) so a bare
+    # ``import fedtpu`` doesn't pull jax/pandas/orbax/sklearn.
+    "run_experiment": ("fedtpu.orchestration.loop", "run_experiment"),
+    "build_experiment": ("fedtpu.orchestration.loop", "build_experiment"),
+    "run_grid_search": ("fedtpu.sweep.grid", "run_grid_search"),
+    "run_parity_demo": ("fedtpu.parity.sklearn_warmstart", "run_parity_demo"),
+    "make_mesh": ("fedtpu.parallel.mesh", "make_mesh"),
+    "client_sharding": ("fedtpu.parallel.mesh", "client_sharding"),
+    "build_round_fn": ("fedtpu.parallel.round", "build_round_fn"),
+    "init_federated_state": ("fedtpu.parallel.round", "init_federated_state"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value          # cache: next access skips __getattr__
+        return value
+    raise AttributeError(f"module 'fedtpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

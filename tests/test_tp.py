@@ -7,6 +7,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
                            ModelConfig, OptimConfig, RunConfig, ShardConfig)
@@ -21,12 +22,16 @@ from fedtpu.parallel.round import build_round_fn, init_federated_state
 HIDDEN = (16, 8)  # both divisible by the tp extent 2
 
 
-def _engines(rounds_per_step=1, num_clients=8):
-    x, y = synthetic_income_like(256, 6, 2)
+def _engines(rounds_per_step=1, num_clients=8, hidden=HIDDEN,
+             weighting="data_size", seed=3, rows=256):
+    """Build the SAME federated setup on both engines (one construction path
+    — signature changes to build_round_fn/init_federated_state show up here
+    once, for every test)."""
+    x, y = synthetic_income_like(rows, 6, 2, seed=seed)
     packed = pack_clients(x, y, ShardConfig(num_clients=num_clients,
                                             shuffle=False))
     init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
-                                                hidden_sizes=HIDDEN))
+                                                hidden_sizes=hidden))
     tx = build_optimizer(OptimConfig())
     key = jax.random.key(3)
 
@@ -34,14 +39,14 @@ def _engines(rounds_per_step=1, num_clients=8):
     s1 = init_federated_state(key, mesh1, num_clients, init_fn, tx)
     b1 = {k: jax.device_put(v, client_sharding(mesh1)) for k, v in
           {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
-    step1 = build_round_fn(mesh1, apply_fn, tx, 2,
+    step1 = build_round_fn(mesh1, apply_fn, tx, 2, weighting=weighting,
                            rounds_per_step=rounds_per_step)
 
     mesh2 = tp.make_mesh_2d(2, num_clients)
     s2 = tp.init_federated_state_2d(key, mesh2, num_clients, init_fn, tx)
     b2 = {k: jax.device_put(v, tp.batch_sharding_2d(mesh2)) for k, v in
           {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
-    step2 = tp.build_round_fn_2d(mesh2, apply_fn, tx, 2,
+    step2 = tp.build_round_fn_2d(mesh2, apply_fn, tx, 2, weighting=weighting,
                                  rounds_per_step=rounds_per_step)
     return (s1, b1, step1), (s2, b2, step2)
 
@@ -90,6 +95,29 @@ def test_2d_engine_multi_round_scan():
     assert int(s2["round"]) == 4
 
 
+@pytest.mark.parametrize("hidden,clients,weighting", [
+    ((16,), 4, "data_size"),          # single hidden layer (col then logits)
+    ((16, 8), 8, "uniform"),          # even depth, uniform averaging
+    ((16, 8, 4), 8, "data_size"),     # odd depth: ends col-sharded pre-logits
+])
+def test_engines_agree_across_configs(hidden, clients, weighting):
+    """Config-sweep contract: for any depth/clients/weighting combo the 1-D
+    shard_map engine and the 2-D GSPMD engine produce the same params."""
+    (s1, b1, step1), (s2, b2, step2) = _engines(
+        num_clients=clients, hidden=hidden, weighting=weighting,
+        seed=clients, rows=32 * clients)
+    for _ in range(2):
+        s1, m1 = step1(s1, b1)
+        s2, m2 = step2(s2, b2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=2e-5, atol=1e-5),
+        s1["params"], s2["params"])
+    np.testing.assert_allclose(np.asarray(m1["per_client"]["accuracy"]),
+                               np.asarray(m2["per_client"]["accuracy"]),
+                               atol=1e-6)
+
+
 def test_checkpoint_resume_preserves_tp_layout(tmp_path):
     cfg = ExperimentConfig(
         data=DataConfig(csv_path=None, synthetic_rows=256),
@@ -116,7 +144,6 @@ def test_checkpoint_resume_preserves_tp_layout(tmp_path):
 
 
 def test_unsupported_combos_raise():
-    import pytest
     base = ExperimentConfig(
         data=DataConfig(csv_path=None, synthetic_rows=128),
         shard=ShardConfig(num_clients=8),
