@@ -46,6 +46,25 @@ def _right_perm(n: int):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
+def flatten_pad(x: jax.Array, multiple: int, dtype=None):
+    """Flatten ``x`` and zero-pad to a multiple; returns ``(flat, pad)``.
+    Shared by the chunked ring schedules here and the Pallas RDMA kernel
+    (fedtpu.parallel.ring_pallas)."""
+    flat = (x if dtype is None else x.astype(dtype)).reshape(-1)
+    pad = (-flat.size) % multiple
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat, pad
+
+
+def unpad_reshape(flat: jax.Array, pad: int, shape, dtype=None):
+    """Inverse of :func:`flatten_pad`."""
+    if pad:
+        flat = flat[:-pad]
+    out = flat.reshape(shape)
+    return out if dtype is None else out.astype(dtype)
+
+
 def ring_all_reduce_sum(x: jax.Array, axis_name: str, axis_size: int):
     """Rotate-and-accumulate ring all-reduce: after N-1 neighbor hops every
     shard holds ``sum_i x_i``."""
@@ -71,10 +90,7 @@ def ring_all_reduce_sum_rsag(x: jax.Array, axis_name: str, axis_size: int):
     if n == 1:
         return x
     shape = x.shape
-    flat = x.reshape(-1)
-    pad = (-flat.size) % n
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    flat, pad = flatten_pad(x, n)
     chunks = flat.reshape(n, -1)                     # (n, B/n)
     me = jax.lax.axis_index(axis_name)
     perm = _right_perm(n)
@@ -105,10 +121,7 @@ def ring_all_reduce_sum_rsag(x: jax.Array, axis_name: str, axis_size: int):
         return (out, rot), None
 
     (out, _), _ = jax.lax.scan(ag_hop, (out, owned), jnp.arange(n - 1))
-    full = out.reshape(-1)
-    if pad:
-        full = full[:-pad]
-    return full.reshape(shape)
+    return unpad_reshape(out.reshape(-1), pad, shape)
 
 
 def make_all_reduce(kind: str, axis_name: str, axis_size: int):
