@@ -39,6 +39,20 @@ def _participation_rate(text: str) -> float:
     return rate
 
 
+def _positive_int(text: str) -> int:
+    value = int(text)
+    if value < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {value}")
+    return value
+
+
+def _nonnegative_float(text: str) -> float:
+    value = float(text)
+    if value < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0, got {value}")
+    return value
+
+
 def _add_common_overrides(p: argparse.ArgumentParser):
     p.add_argument("--preset", default="income-8", choices=sorted(PRESETS))
     p.add_argument("--csv", default=None, help="dataset CSV path")
@@ -49,6 +63,12 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    help="comma-separated, e.g. 50,200")
     p.add_argument("--learning-rate", type=float, default=None)
     p.add_argument("--weighting", choices=["data_size", "uniform"], default=None)
+    p.add_argument("--local-steps", type=_positive_int, default=None,
+                   help="full-batch steps per client per round (classic "
+                        "FedAvg E >= 1; reference does 1)")
+    p.add_argument("--prox-mu", type=_nonnegative_float, default=None,
+                   help="FedProx proximal coefficient >= 0 (0 = plain "
+                        "FedAvg; meaningful with --local-steps > 1)")
     p.add_argument("--participation-rate", type=_participation_rate,
                    default=None,
                    help="per-round client sampling probability in (0, 1] "
@@ -102,6 +122,10 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         fed = dataclasses.replace(fed, rounds=args.rounds)
     if args.weighting is not None:
         fed = dataclasses.replace(fed, weighting=args.weighting)
+    if args.local_steps is not None:
+        fed = dataclasses.replace(fed, local_steps=args.local_steps)
+    if args.prox_mu is not None:
+        fed = dataclasses.replace(fed, prox_mu=args.prox_mu)
     if args.participation_rate is not None:
         fed = dataclasses.replace(fed,
                                   participation_rate=args.participation_rate)

@@ -140,6 +140,14 @@ class FedConfig:
     # ring is the ICI-native answer to the reference's rank-0
     # gather/average/bcast (FL_CustomMLP...:101-120).
     aggregation: str = "psum"
+    # Classic-FedAvg local work per round. The reference does exactly ONE
+    # full-batch step per round (train_one_epoch, FL_CustomMLP...:63-73);
+    # local_steps=E runs E of them (epoch == step under full batch).
+    local_steps: int = 1
+    # FedProx proximal coefficient: mu/2 * ||w - w_round_start||^2 added to
+    # each local loss. Zero gradient at the anchor, so meaningful only with
+    # local_steps > 1 (bounds client drift on non-IID shards). 0 = FedAvg.
+    prox_mu: float = 0.0
     # Each client starts from an independent random init, matching the
     # reference where every rank constructs an unseeded torch model
     # (FL_CustomMLP...:42). Set True to start all clients identical.

@@ -145,7 +145,8 @@ def build_experiment(cfg: ExperimentConfig,
             init_fn, tx, same_init=cfg.fed.same_init)
         step_fn = lambda r: tp.build_round_fn_2d(
             mesh, apply_fn, tx, ds.num_classes, weighting=cfg.fed.weighting,
-            rounds_per_step=r)
+            rounds_per_step=r, local_steps=cfg.fed.local_steps,
+            prox_mu=cfg.fed.prox_mu)
     else:
         mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
         shard = client_sharding(mesh)
@@ -157,7 +158,9 @@ def build_experiment(cfg: ExperimentConfig,
             rounds_per_step=r,
             participation_rate=cfg.fed.participation_rate,
             participation_seed=cfg.fed.participation_seed,
-            aggregation=cfg.fed.aggregation)
+            aggregation=cfg.fed.aggregation,
+            local_steps=cfg.fed.local_steps,
+            prox_mu=cfg.fed.prox_mu)
 
     batch = {
         "x": jax.device_put(packed.x, shard),

@@ -77,7 +77,9 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                    rounds_per_step: int = 1,
                    participation_rate: float = 1.0,
                    participation_seed: int = 0,
-                   aggregation: str = "psum"):
+                   aggregation: str = "psum",
+                   local_steps: int = 1,
+                   prox_mu: float = 0.0):
     """Compile the full federated round. Returns
     ``round_step(state, batch) -> (state, metrics)`` where ``batch`` is a dict
     of client-sharded arrays ``x (C,N,...), y (C,N), mask (C,N)`` and
@@ -103,7 +105,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     and params carry over unchanged.
     """
 
-    local_train = make_local_train_step(apply_fn, tx)
+    local_train = make_local_train_step(apply_fn, tx, local_steps=local_steps,
+                                        prox_mu=prox_mu)
     local_eval = make_local_eval_step(apply_fn, num_classes)
 
     sampling = participation_rate < 1.0

@@ -106,13 +106,17 @@ def batch_sharding_2d(mesh: Mesh) -> NamedSharding:
 def build_round_fn_2d(mesh: Mesh, apply_fn: Callable,
                       tx: optax.GradientTransformation, num_classes: int,
                       weighting: str = "data_size",
-                      rounds_per_step: int = 1) -> Callable:
+                      rounds_per_step: int = 1,
+                      local_steps: int = 1,
+                      prox_mu: float = 0.0) -> Callable:
     """The federated round as a global-view jit program on the 2-D mesh.
-    Semantics mirror fedtpu.parallel.round.build_round_fn (one full-batch
-    step per client, then the weighted average of FL_CustomMLP...:108-119 as
-    a plain tensordot over the clients axis — GSPMD lowers it to the
-    cross-device reduction)."""
-    local_train = make_local_train_step(apply_fn, tx)
+    Semantics mirror fedtpu.parallel.round.build_round_fn: ``local_steps``
+    full-batch steps per client (default 1 == the reference cadence), an
+    optional FedProx term (``prox_mu``), then the weighted average of
+    FL_CustomMLP...:108-119 as a plain tensordot over the clients axis —
+    GSPMD lowers it to the cross-device reduction."""
+    local_train = make_local_train_step(apply_fn, tx, local_steps=local_steps,
+                                        prox_mu=prox_mu)
     local_eval = make_local_eval_step(apply_fn, num_classes)
 
     def constrain(params, specs):

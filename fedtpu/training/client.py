@@ -31,17 +31,57 @@ from fedtpu.ops.metrics import confusion_matrix
 
 
 def make_local_train_step(apply_fn: Callable,
-                          tx: optax.GradientTransformation) -> Callable:
+                          tx: optax.GradientTransformation,
+                          local_steps: int = 1,
+                          prox_mu: float = 0.0) -> Callable:
     """Returns ``step(params, opt_state, x, y, mask) ->
-    (params, opt_state, loss)`` — one full-batch update."""
+    (params, opt_state, loss)`` — ``local_steps`` full-batch updates.
+
+    Defaults reproduce the reference exactly: ONE step per round
+    (``train_one_epoch``, FL_CustomMLP...:63-73). ``local_steps=E`` is
+    classic FedAvg's E local epochs (full-batch, so epoch == step here);
+    the LR schedule advances per optimizer update, as the reference's
+    StepLR does (:73). ``prox_mu`` adds the FedProx proximal term
+    ``mu/2 * ||w - w_global||^2`` against the round-start params — zero
+    gradient at the anchor, so it only matters when ``local_steps > 1``
+    (it bounds client drift on non-IID shards)."""
+
+    if local_steps < 1:
+        raise ValueError(f"local_steps must be >= 1, got {local_steps}")
+    if prox_mu < 0:
+        raise ValueError(f"prox_mu must be >= 0, got {prox_mu} "
+                         "(negative mu amplifies drift instead of bounding it)")
 
     def step(params, opt_state, x, y, mask):
-        def loss_fn(p):
-            return masked_cross_entropy(apply_fn(p, x), y, mask)
+        anchor = params
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        def one(carry, _):
+            p, s = carry
+
+            def loss_fn(q):
+                # The optimized objective may include the prox penalty, but
+                # the REPORTED loss stays plain masked CE — comparable
+                # across prox/non-prox runs and to the reference's loss.
+                ce = masked_cross_entropy(apply_fn(q, x), y, mask)
+                obj = ce
+                if prox_mu:
+                    sq = sum(jnp.sum(jnp.square(a - b))
+                             for a, b in zip(jax.tree.leaves(q),
+                                             jax.tree.leaves(anchor)))
+                    obj = ce + 0.5 * prox_mu * sq
+                return obj, ce
+
+            (_, ce), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            updates, s = tx.update(grads, s, p)
+            return (optax.apply_updates(p, updates), s), ce
+
+        if local_steps == 1:
+            (params, opt_state), loss = one((params, opt_state), None)
+        else:
+            (params, opt_state), losses = jax.lax.scan(
+                one, (params, opt_state), length=local_steps)
+            loss = losses[-1]
+        return params, opt_state, loss
 
     return step
 
