@@ -36,6 +36,7 @@ import os
 from typing import Callable, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from fedtpu.config import ExperimentConfig
@@ -184,6 +185,15 @@ def build_experiment(cfg: ExperimentConfig,
                       eval_step=eval_step, dataset=ds, mesh=mesh)
 
 
+@jax.jit
+def _tree_finite(tree) -> jax.Array:
+    """Single-scalar device reduction: every floating leaf entirely finite
+    (integer leaves — optimizer step counts — cannot be non-finite)."""
+    checks = [jnp.all(jnp.isfinite(l)) for l in jax.tree.leaves(tree)
+              if jnp.issubdtype(l.dtype, jnp.inexact)]
+    return jnp.all(jnp.stack(checks)) if checks else jnp.array(True)
+
+
 def _unstack_metrics(metrics: dict, take: int) -> List[dict]:
     """Per-round metric dicts out of a (possibly R-stacked) metrics pytree."""
     if take == 1:
@@ -228,6 +238,20 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     stopped_early = False
     diverged = False
     rounds_run = 0
+
+    def halt_diverged(reason: str, label_round: int):
+        """Shared divergence halt: quarantine the poisoned state under
+        diverged/ (so latest_step() — and therefore resume — still finds the
+        last GOOD periodic checkpoint) and stop the loop. Under chunking the
+        saved state is the chunk-end state; ``label_round`` says so."""
+        nonlocal stopped_early, diverged
+        if verbose:
+            print(f"Non-finite {reason}; halting (diverged run).", flush=True)
+        if cfg.run.checkpoint_dir:
+            save_checkpoint(os.path.join(cfg.run.checkpoint_dir, "diverged"),
+                            state, history, label_round)
+        stopped_early = True
+        diverged = True
 
     if restored_history is not None:
         for k in METRIC_NAMES:
@@ -307,20 +331,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 if cfg.run.halt_on_nonfinite and not (
                         np.all(np.isfinite(cur))
                         and np.all(np.isfinite(losses[-1]))):
-                    if verbose:
-                        print(f"Non-finite loss/metrics at round {r + 1}; "
-                              "halting (diverged run).", flush=True)
-                    if cfg.run.checkpoint_dir:
-                        # Quarantined under diverged/ so latest_step() — and
-                        # therefore resume — still finds the last GOOD
-                        # periodic checkpoint, not the poisoned state. The
-                        # saved state is the chunk-end state (round
-                        # rnd + take under chunking), labeled as such.
-                        save_checkpoint(
-                            os.path.join(cfg.run.checkpoint_dir, "diverged"),
-                            state, history, rnd + take)
-                    stopped_early = True
-                    diverged = True
+                    halt_diverged(f"loss/metrics at round {r + 1}",
+                                  rnd + take)
                     break
 
                 # Early stopping — exact reference logic (FL_CustomMLP...:181-192).
@@ -344,6 +356,20 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             if stopped_early:
                 # The chunk overshot the stop round; don't checkpoint or eval the
                 # overshoot state (the unchunked loop's `break` skips these too).
+                break
+
+            # Chunk-end state check: metrics can stay finite for one round
+            # AFTER params go NaN (argmax over NaN logits yields index 0, and
+            # the reported loss is computed at pre-update params), and Adam
+            # moments can overflow while params are still finite — so the
+            # per-round metric guard above would let a periodic checkpoint
+            # capture a poisoned state as "good". Gate the checkpoint on the
+            # actual full state (params + optimizer moments).
+            if cfg.run.halt_on_nonfinite and not bool(_tree_finite(
+                    {"params": state["params"],
+                     "opt_state": state["opt_state"]})):
+                halt_diverged(f"params/optimizer state after round {rnd}",
+                              rnd)
                 break
 
             # Held-out eval / checkpoint at chunk boundaries when due within the

@@ -1,9 +1,11 @@
 """Auxiliary subsystems (SURVEY.md §5 gaps the reference left open): JSONL
 metrics logging, profiler wiring, CIFAR-10 loader, multi-host helpers."""
 
+import dataclasses
 import json
 import os
 
+import jax
 import numpy as np
 
 from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
@@ -64,6 +66,63 @@ def test_nonfinite_guard_halts_diverged_run(tmp_path):
     # see it as the latest periodic checkpoint.
     assert latest_step(str(tmp_path / "ck")) is None
     assert latest_step(str(tmp_path / "ck" / "diverged")) == res.rounds_run
+
+
+def test_resume_after_divergence_restores_last_good_checkpoint(tmp_path):
+    """A diverged run must leave resume pointing at the last GOOD periodic
+    checkpoint — never the quarantined NaN state."""
+    from fedtpu.config import OptimConfig
+    ck = str(tmp_path / "ck")
+    good = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=128),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=2),
+        run=RunConfig(checkpoint_dir=ck, checkpoint_every=1),
+    )
+    run_experiment(good, verbose=False)        # rounds 1-2 checkpointed, finite
+
+    bad = dataclasses.replace(
+        good, optim=OptimConfig(learning_rate=1e18),
+        fed=FedConfig(rounds=10))
+    res = run_experiment(bad, verbose=False, resume=True)
+    assert res.diverged
+
+    from fedtpu.orchestration.checkpoint import latest_step, load_checkpoint
+    from fedtpu.orchestration.loop import build_experiment
+    # The guard's contract: whatever the latest periodic checkpoint is, its
+    # params are FINITE (a non-finite state may only ever be quarantined).
+    # With lr=1e18 the first bad update leaves huge-but-finite params (so
+    # its round may legitimately checkpoint); NaN states may not.
+    exp = build_experiment(good)
+    state, _, step = load_checkpoint(ck, state_like=exp.state)
+    assert step >= 2
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(state["params"]))
+    # The poisoned state is quarantined separately, NaN and all.
+    assert latest_step(os.path.join(ck, "diverged")) is not None
+    bad_state, _, _ = load_checkpoint(os.path.join(ck, "diverged"),
+                                      state_like=exp.state)
+    assert not all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree.leaves(bad_state["params"]))
+
+
+def test_divergence_in_chunked_run_labels_chunk_end(tmp_path):
+    """With rounds_per_step>1 the quarantined state is the chunk-end state
+    and must be labeled as such (not the in-chunk detection round)."""
+    from fedtpu.config import OptimConfig
+    ck = str(tmp_path / "ck")
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=128),
+        shard=ShardConfig(num_clients=8),
+        optim=OptimConfig(learning_rate=1e18),
+        fed=FedConfig(rounds=20),
+        run=RunConfig(checkpoint_dir=ck, rounds_per_step=5),
+    )
+    res = run_experiment(cfg, verbose=False)
+    assert res.diverged
+    from fedtpu.orchestration.checkpoint import latest_step
+    step = latest_step(os.path.join(ck, "diverged"))
+    assert step is not None and step % 5 == 0  # chunk-end label
 
 
 def test_cifar10_synthetic_fallback_shapes():
