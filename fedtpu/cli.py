@@ -180,6 +180,10 @@ def main(argv=None) -> int:
     sweep_p.add_argument("--no-vmap-lr", action="store_true",
                          help="run learning rates sequentially instead of "
                               "vmapped (parity-check path; ~9x slower)")
+    sweep_p.add_argument("--table-jsonl", default=None,
+                         help="write the full per-config result table here, "
+                              "one JSON line per config (the reference only "
+                              "prints the best, hyperparameters_tuning.py:126)")
 
     parity_p = sub.add_parser("parity",
                               help="sklearn warm-start limitation demo")
@@ -205,8 +209,23 @@ def main(argv=None) -> int:
         summary = result.summary()
     elif args.cmd == "sweep":
         from fedtpu.sweep.grid import run_grid_search
-        summary = run_grid_search(cfg, vmap_lr=not args.no_vmap_lr,
-                                  verbose=not args.quiet)
+        # Open the table file BEFORE the (minutes-long) sweep so a bad path
+        # fails fast instead of discarding the finished run's output.
+        table_f = open(args.table_jsonl, "w") if args.table_jsonl else None
+        try:
+            summary = run_grid_search(
+                cfg, vmap_lr=not args.no_vmap_lr,
+                # --local-steps overrides the grid's reference default of
+                # 400 (MLPClassifier max_iter, hyperparameters_tuning.py:90).
+                **({"local_steps": args.local_steps}
+                   if args.local_steps is not None else {}),
+                verbose=not args.quiet)
+            if table_f is not None:
+                for row in summary["table"]:
+                    table_f.write(json.dumps(row, default=float) + "\n")
+        finally:
+            if table_f is not None:
+                table_f.close()
     elif args.cmd == "parity":
         from fedtpu.parity.sklearn_warmstart import run_parity_demo
         summary = run_parity_demo(cfg, verbose=not args.quiet)

@@ -101,11 +101,14 @@ def _build_sweep_fn(mesh, num_classes: int, local_steps: int, optim_cfg):
 
 
 def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
-                    hidden_grid=HIDDEN_GRID, lr_grid=LR_GRID,
+                    hidden_grid=None, lr_grid=None,
                     local_steps: int = 400, vmap_lr: bool = True,
                     verbose: bool = True) -> dict:
     """Run the 90-config federated grid; returns the best-config summary
-    (the reference's :126-132 printout, as data)."""
+    (the reference's :126-132 printout, as data). ``hidden_grid``/``lr_grid``
+    default to the module-level reference grids, resolved at call time."""
+    hidden_grid = HIDDEN_GRID if hidden_grid is None else hidden_grid
+    lr_grid = LR_GRID if lr_grid is None else lr_grid
     ds = dataset or load_dataset(cfg.data)
     mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
     shard = client_sharding(mesh)

@@ -21,3 +21,48 @@ def test_run_with_overrides_json(capsys):
     summary = json.loads(line)
     assert summary["rounds_run"] == 3
     assert "accuracy" in summary["final_global_metrics"]
+
+
+def test_sweep_table_jsonl(tmp_path, monkeypatch):
+    # Shrink the grid (2 archs x 9 lrs) — the full 10x9 takes minutes on CPU;
+    # the full-size grid is exercised by the recorded TPU run (RESULTS.md).
+    from fedtpu.sweep import grid
+    monkeypatch.setattr(grid, "HIDDEN_GRID", ((8,), (8, 8)))
+    path = str(tmp_path / "table.jsonl")
+    rc = main(["sweep", "--csv", "", "--num-clients", "2",
+               "--table-jsonl", path, "--quiet"])
+    assert rc == 0
+    rows = [json.loads(l) for l in open(path)]
+    assert len(rows) == 2 * 9
+    assert {"hidden_layer_sizes", "learning_rate", "accuracy",
+            "f1"} <= set(rows[0])
+
+
+def test_sweep_bad_table_path_fails_fast(monkeypatch):
+    import pytest
+    from fedtpu.sweep import grid
+
+    def boom(*a, **k):                    # the sweep must never start
+        raise AssertionError("sweep ran despite bad table path")
+
+    monkeypatch.setattr(grid, "run_grid_search", boom)
+    with pytest.raises(FileNotFoundError):
+        main(["sweep", "--csv", "", "--num-clients", "2",
+              "--table-jsonl", "/nonexistent-dir/t.jsonl", "--quiet"])
+
+
+def test_sweep_honors_local_steps(tmp_path, monkeypatch):
+    from fedtpu.sweep import grid
+    seen = {}
+    real = grid.run_grid_search
+
+    def spy(cfg, **kw):
+        seen.update(kw)
+        kw.setdefault("hidden_grid", ((8,),))
+        kw.setdefault("lr_grid", (0.004,))
+        return real(cfg, **kw)
+
+    monkeypatch.setattr(grid, "run_grid_search", spy)
+    main(["sweep", "--csv", "", "--num-clients", "2", "--local-steps", "7",
+          "--quiet"])
+    assert seen.get("local_steps") == 7
