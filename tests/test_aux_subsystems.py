@@ -151,3 +151,44 @@ def test_multihost_single_process_paths():
     batch = multihost.distribute_client_batch(packed, mesh)
     np.testing.assert_allclose(np.asarray(batch["x"]), packed.x)
     assert len(batch["x"].sharding.device_set) == 8  # client-axis sharded
+
+
+def test_local_client_slice_multiprocess_simulated(monkeypatch):
+    """Simulate a 4-process pod (2 devices each) with fake device objects:
+    each process must own exactly its contiguous block of the client axis,
+    and the blocks must partition it."""
+    import types
+
+    class FakeDevice:
+        def __init__(self, pid):
+            self.process_index = pid
+
+    # 8 devices, process layout [0,0,1,1,2,2,3,3] — the standard pod order.
+    devices = np.array([FakeDevice(i // 2) for i in range(8)])
+    mesh = types.SimpleNamespace(devices=devices)
+
+    slices = []
+    for pid in range(4):
+        monkeypatch.setattr(multihost.jax, "process_index", lambda p=pid: p)
+        slices.append(multihost.local_client_slice(32, mesh))
+    # 32 clients / 8 devices = 4 per device; 2 devices per process = 8 rows.
+    assert slices == [slice(0, 8), slice(8, 16), slice(16, 24), slice(24, 32)]
+    # A process owning no devices of this mesh gets the empty slice.
+    monkeypatch.setattr(multihost.jax, "process_index", lambda: 9)
+    assert multihost.local_client_slice(32, mesh) == slice(0, 0)
+
+
+def test_looks_multihost_env_detection(monkeypatch):
+    # Clear EVERY hint the detector consults — on a real pod worker some
+    # (TPU_WORKER_HOSTNAMES, COORDINATOR_ADDRESS) are legitimately set and
+    # would make the baseline assert fail spuriously.
+    for var in (*multihost._MULTIHOST_ENV_HINTS,
+                "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE"):
+        monkeypatch.delenv(var, raising=False)
+    assert not multihost._looks_multihost()
+    monkeypatch.setenv("SLURM_NTASKS", "4")
+    assert multihost._looks_multihost()
+    monkeypatch.setenv("SLURM_NTASKS", "1")
+    assert not multihost._looks_multihost()
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    assert multihost._looks_multihost()
