@@ -122,8 +122,9 @@ def build_experiment(cfg: ExperimentConfig,
     if cfg.run.model_parallel > 1:
         # 2-D ('clients','model') GSPMD engine (fedtpu.parallel.tp).
         from fedtpu.parallel import tp
-        if model_cfg.kind != "mlp":
-            raise ValueError("model_parallel > 1 supports the MLP family only")
+        if model_cfg.kind not in ("mlp", "convnet"):
+            raise ValueError("model_parallel > 1 supports the MLP and "
+                             "ConvNet families only")
         if cfg.fed.participation_rate < 1.0:
             raise ValueError("partial participation requires the 1-D engine "
                              "(model_parallel=1)")
@@ -131,11 +132,18 @@ def build_experiment(cfg: ExperimentConfig,
             raise ValueError("explicit ring aggregation requires the 1-D "
                              "engine (model_parallel=1); the 2-D engine's "
                              "collectives are GSPMD-chosen")
-        bad = [h for h in model_cfg.hidden_sizes
-               if h % cfg.run.model_parallel]
+        # Only dims the tp specs actually place on the 'model' axis need to
+        # divide: the col-sharded out-dims (even indices — row layers shard
+        # the PREVIOUS layer's out-dim, already covered) plus, for convnets,
+        # the dense hidden dim (col out / head row in).
+        sharded_dims = (model_cfg.hidden_sizes[0::2]
+                        if model_cfg.kind == "mlp"
+                        else (*model_cfg.conv_channels[0::2],
+                              model_cfg.hidden_sizes[0]))
+        bad = [h for h in sharded_dims if h % cfg.run.model_parallel]
         if bad:
             raise ValueError(
-                f"hidden sizes {bad} not divisible by "
+                f"sharded dims {bad} not divisible by "
                 f"model_parallel={cfg.run.model_parallel}; uneven shards "
                 "would silently pad and imbalance memory/compute")
         mesh = tp.make_mesh_2d(cfg.run.model_parallel, cfg.shard.num_clients,

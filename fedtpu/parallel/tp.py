@@ -83,6 +83,45 @@ def mlp_tp_specs(params) -> dict:
     return {"layers": specs}
 
 
+def convnet_tp_specs(params) -> dict:
+    """PartitionSpecs for the ConvNet pytree (fedtpu.models.convnet): conv
+    kernels (kh, kw, cin, cout) alternate output-channel sharding
+    (``P(clients, None, None, None, model)``, bias sharded) and
+    input-channel sharding (``P(clients, None, None, model, None)``, bias
+    replicated — the conv analogue of Megatron column/row Linear); the dense
+    layer column-shards its hidden dim and the head row-shards it (the
+    classic pair), leaving logits replicated for the loss."""
+    specs_convs = []
+    col = True
+    for _ in params["convs"]:
+        if col:
+            specs_convs.append({"w": P(CLIENTS_AXIS, None, None, None,
+                                       MODEL_AXIS),
+                                "b": P(CLIENTS_AXIS, MODEL_AXIS)})
+        else:
+            specs_convs.append({"w": P(CLIENTS_AXIS, None, None, MODEL_AXIS,
+                                       None),
+                                "b": P(CLIENTS_AXIS)})
+        col = not col
+    return {
+        "convs": specs_convs,
+        "dense": {"w": P(CLIENTS_AXIS, None, MODEL_AXIS),
+                  "b": P(CLIENTS_AXIS, MODEL_AXIS)},
+        "head": {"w": P(CLIENTS_AXIS, MODEL_AXIS, None),
+                 "b": P(CLIENTS_AXIS)},
+    }
+
+
+def tp_specs(params) -> dict:
+    """Model-structure dispatch: the 2-D layout for any supported family."""
+    if "convs" in params:
+        return convnet_tp_specs(params)
+    if "layers" in params:
+        return mlp_tp_specs(params)
+    raise ValueError("unrecognized params structure for tensor-parallel "
+                     f"layout: keys {sorted(params)}")
+
+
 def init_federated_state_2d(key: jax.Array, mesh: Mesh, num_clients: int,
                             init_fn: Callable,
                             tx: optax.GradientTransformation,
@@ -90,7 +129,7 @@ def init_federated_state_2d(key: jax.Array, mesh: Mesh, num_clients: int,
     """Global-view per-client state laid out on the 2-D mesh. Optimizer
     moments inherit the param shardings via jit sharding propagation."""
     params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
-    specs = mlp_tp_specs(params)
+    specs = tp_specs(params)
     params = jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
     opt_state = jax.jit(jax.vmap(tx.init))(params)
@@ -127,7 +166,7 @@ def build_round_fn_2d(mesh: Mesh, apply_fn: Callable,
     @jax.jit
     def round_step(state, batch):
         x, y, mask = batch["x"], batch["y"], batch["mask"]
-        specs = mlp_tp_specs(state["params"])
+        specs = tp_specs(state["params"])
 
         def one_round(carry, _):
             params, opt_state = carry

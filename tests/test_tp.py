@@ -118,6 +118,50 @@ def test_engines_agree_across_configs(hidden, clients, weighting):
                                atol=1e-6)
 
 
+def test_convnet_engines_agree():
+    """ConvNet on the 2-D mesh: conv kernels channel-shard over 'model' and
+    the round must match the 1-D engine."""
+    from fedtpu.data.cifar10 import synthetic_cifar_like
+    x, y = synthetic_cifar_like(64, seed=4, image_shape=(8, 8, 3), classes=4)
+    x = x.reshape(64, -1)
+    packed = pack_clients(x, y, ShardConfig(num_clients=8, shuffle=False))
+    model_cfg = ModelConfig(kind="convnet", image_shape=(8, 8, 3),
+                            conv_channels=(4, 8), hidden_sizes=(16,),
+                            num_classes=4)
+    init_fn, apply_fn = build_model(model_cfg)
+    tx = build_optimizer(OptimConfig())
+    key = jax.random.key(9)
+    feed = {"x": packed.x, "y": packed.y, "mask": packed.mask}
+
+    mesh1 = make_mesh(num_clients=8)
+    s1 = init_federated_state(key, mesh1, 8, init_fn, tx)
+    b1 = {k: jax.device_put(v, client_sharding(mesh1)) for k, v in feed.items()}
+    step1 = build_round_fn(mesh1, apply_fn, tx, 4)
+
+    mesh2 = tp.make_mesh_2d(2, 8)
+    s2 = tp.init_federated_state_2d(key, mesh2, 8, init_fn, tx)
+    b2 = {k: jax.device_put(v, tp.batch_sharding_2d(mesh2))
+          for k, v in feed.items()}
+    step2 = tp.build_round_fn_2d(mesh2, apply_fn, tx, 4)
+
+    # Conv kernels really are channel-sharded over 'model'.
+    w0 = s2["params"]["convs"][0]["w"]          # (C, 3, 3, 3, 4) col-sharded
+    assert {s.data.shape for s in w0.addressable_shards} == {(2, 3, 3, 3, 2)}
+    w1 = s2["params"]["convs"][1]["w"]          # (C, 3, 3, 4, 8) row-sharded
+    assert {s.data.shape for s in w1.addressable_shards} == {(2, 3, 3, 2, 8)}
+
+    for _ in range(2):
+        s1, m1 = step1(s1, b1)
+        s2, m2 = step2(s2, b2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=2e-5, atol=1e-5),
+        s1["params"], s2["params"])
+    np.testing.assert_allclose(np.asarray(m1["per_client"]["accuracy"]),
+                               np.asarray(m2["per_client"]["accuracy"]),
+                               atol=1e-6)
+
+
 def test_checkpoint_resume_preserves_tp_layout(tmp_path):
     cfg = ExperimentConfig(
         data=DataConfig(csv_path=None, synthetic_rows=256),
@@ -158,7 +202,11 @@ def test_unsupported_combos_raise():
     with pytest.raises(ValueError, match="divisible"):
         build_experiment(dataclasses.replace(
             base, model=dataclasses.replace(base.model,
-                                            hidden_sizes=(50, 25))))
+                                            hidden_sizes=(25, 16))))
+    # Odd-index dims are never placed on the model axis (row layers shard
+    # the previous out-dim), so (50, 25) is a VALID layout at tp=2.
+    build_experiment(dataclasses.replace(
+        base, model=dataclasses.replace(base.model, hidden_sizes=(50, 25))))
 
 
 def test_run_experiment_model_parallel():
