@@ -71,8 +71,10 @@ def test_pallas_ring_capacity_credits_balance(n):
         assert consumed[p] + residual[p] == received[p]
 
 
+@pytest.mark.parametrize("rounds_per_step", [1, 3])
 @pytest.mark.parametrize("aggregation", ["ring", "ring-rsag"])
-def test_round_with_ring_aggregation_matches_psum(aggregation):
+def test_round_with_ring_aggregation_matches_psum(aggregation,
+                                                  rounds_per_step):
     from fedtpu.parallel import make_mesh
     from fedtpu.parallel.round import build_round_fn
     state, batch, _, packed = _setup()
@@ -83,8 +85,10 @@ def test_round_with_ring_aggregation_matches_psum(aggregation):
     _, apply_fn = build_model(ModelConfig(input_dim=6, hidden_sizes=(8,)))
     tx = build_optimizer(OptimConfig())
 
-    step_psum = build_round_fn(mesh, apply_fn, tx, 2, aggregation="psum")
-    step_ring = build_round_fn(mesh, apply_fn, tx, 2, aggregation=aggregation)
+    step_psum = build_round_fn(mesh, apply_fn, tx, 2, aggregation="psum",
+                               rounds_per_step=rounds_per_step)
+    step_ring = build_round_fn(mesh, apply_fn, tx, 2, aggregation=aggregation,
+                               rounds_per_step=rounds_per_step)
     s1, m1 = step_psum(state, batch)
     s2, m2 = step_ring(state, batch)
     # Ring sums in neighbor order — same value up to float reassociation.
@@ -92,5 +96,6 @@ def test_round_with_ring_aggregation_matches_psum(aggregation):
         lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                                 rtol=1e-5, atol=1e-6),
         s1["params"], s2["params"])
-    np.testing.assert_allclose(float(m1["client_mean"]["accuracy"]),
-                               float(m2["client_mean"]["accuracy"]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m1["client_mean"]["accuracy"]),
+                               np.asarray(m2["client_mean"]["accuracy"]),
+                               atol=1e-6)
