@@ -73,6 +73,22 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    default=None,
                    help="per-round client sampling probability in (0, 1] "
                         "(default 1.0)")
+    p.add_argument("--server-opt",
+                   choices=["none", "fedavgm", "fedadagrad", "fedyogi",
+                            "fedadam"],
+                   default=None,
+                   help="server optimizer over client deltas (FedOpt; "
+                        "'none' = the reference's parameter averaging)")
+    p.add_argument("--server-lr", type=float, default=None,
+                   help="server optimizer learning rate (default 1.0)")
+    p.add_argument("--server-momentum", type=_nonnegative_float, default=None,
+                   help="fedavgm momentum (default 0.9)")
+    p.add_argument("--dp-clip-norm", type=_nonnegative_float, default=None,
+                   help="per-client L2 clip of updates (DP-FedAvg; 0 = off)")
+    p.add_argument("--dp-noise-multiplier", type=_nonnegative_float,
+                   default=None,
+                   help="Gaussian noise multiplier on the averaged clipped "
+                        "delta (needs --dp-clip-norm > 0)")
     p.add_argument("--shard-strategy",
                    choices=["contiguous", "label_sort", "dirichlet"],
                    default=None)
@@ -131,6 +147,17 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
                                   participation_rate=args.participation_rate)
     if getattr(args, "aggregation", None) is not None:
         fed = dataclasses.replace(fed, aggregation=args.aggregation)
+    if args.server_opt is not None:
+        fed = dataclasses.replace(fed, server_opt=args.server_opt)
+    if args.server_lr is not None:
+        fed = dataclasses.replace(fed, server_lr=args.server_lr)
+    if args.server_momentum is not None:
+        fed = dataclasses.replace(fed, server_momentum=args.server_momentum)
+    if args.dp_clip_norm is not None:
+        fed = dataclasses.replace(fed, dp_clip_norm=args.dp_clip_norm)
+    if args.dp_noise_multiplier is not None:
+        fed = dataclasses.replace(fed,
+                                  dp_noise_multiplier=args.dp_noise_multiplier)
     run_kw = {}
     if args.checkpoint_dir is not None:
         run_kw["checkpoint_dir"] = args.checkpoint_dir

@@ -148,6 +148,23 @@ class FedConfig:
     # each local loss. Zero gradient at the anchor, so meaningful only with
     # local_steps > 1 (bounds client drift on non-IID shards). 0 = FedAvg.
     prox_mu: float = 0.0
+    # Server-side optimizer over the weighted mean of client DELTAS (FedOpt
+    # family, fedtpu.ops.server_opt): 'none' (parameter averaging — the
+    # reference's rule) | 'fedavgm' | 'fedadagrad' | 'fedyogi' | 'fedadam'.
+    # Requires aggregation='psum' and the 1-D engine.
+    server_opt: str = "none"
+    server_lr: float = 1.0               # 1.0 + fedavgm momentum 0 == FedAvg
+    server_momentum: float = 0.9         # fedavgm only
+    server_b1: float = 0.9               # adaptive server opts
+    server_b2: float = 0.99              # Reddi et al. default
+    server_tau: float = 1e-3             # adaptivity floor
+    # Central differential privacy on the delta path (DP-FedAvg): per-client
+    # L2 clip of the update (0 = off) and Gaussian noise with std
+    # noise_multiplier * clip / total_weight added to the averaged delta.
+    # Use weighting='uniform' for standard sensitivity accounting.
+    dp_clip_norm: float = 0.0
+    dp_noise_multiplier: float = 0.0
+    dp_seed: int = 0
     # Each client starts from an independent random init, matching the
     # reference where every rank constructs an unseeded torch model
     # (FL_CustomMLP...:42). Set True to start all clients identical.

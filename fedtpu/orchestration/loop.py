@@ -132,6 +132,10 @@ def build_experiment(cfg: ExperimentConfig,
             raise ValueError("explicit ring aggregation requires the 1-D "
                              "engine (model_parallel=1); the 2-D engine's "
                              "collectives are GSPMD-chosen")
+        if (cfg.fed.server_opt != "none" or cfg.fed.dp_clip_norm > 0
+                or cfg.fed.dp_noise_multiplier > 0):
+            raise ValueError("server_opt / DP aggregation requires the 1-D "
+                             "engine (model_parallel=1)")
         # Only dims the tp specs actually place on the 'model' axis need to
         # divide: the col-sharded out-dims (even indices — row layers shard
         # the PREVIOUS layer's out-dim, already covered) plus, for convnets,
@@ -159,9 +163,21 @@ def build_experiment(cfg: ExperimentConfig,
     else:
         mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
         shard = client_sharding(mesh)
+        server = None
+        if cfg.fed.server_opt != "none":
+            from fedtpu.ops.server_opt import make_server_optimizer
+            server = make_server_optimizer(
+                cfg.fed.server_opt, learning_rate=cfg.fed.server_lr,
+                momentum=cfg.fed.server_momentum, b1=cfg.fed.server_b1,
+                b2=cfg.fed.server_b2, tau=cfg.fed.server_tau)
+        elif cfg.fed.dp_clip_norm > 0:
+            # DP with plain averaging still runs the delta path and needs
+            # the (empty-momentum) server state initialized.
+            from fedtpu.ops.server_opt import identity_server_optimizer
+            server = identity_server_optimizer()
         state_fn = lambda: init_federated_state(
             jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
-            init_fn, tx, same_init=cfg.fed.same_init)
+            init_fn, tx, same_init=cfg.fed.same_init, server_opt=server)
         step_fn = lambda r: build_round_fn(
             mesh, apply_fn, tx, ds.num_classes, weighting=cfg.fed.weighting,
             rounds_per_step=r,
@@ -169,7 +185,11 @@ def build_experiment(cfg: ExperimentConfig,
             participation_seed=cfg.fed.participation_seed,
             aggregation=cfg.fed.aggregation,
             local_steps=cfg.fed.local_steps,
-            prox_mu=cfg.fed.prox_mu)
+            prox_mu=cfg.fed.prox_mu,
+            server_opt=server,
+            dp_clip_norm=cfg.fed.dp_clip_norm,
+            dp_noise_multiplier=cfg.fed.dp_noise_multiplier,
+            dp_seed=cfg.fed.dp_seed)
 
     batch = {
         "x": jax.device_put(packed.x, shard),
@@ -374,8 +394,9 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # capture a poisoned state as "good". Gate the checkpoint on the
             # actual full state (params + optimizer moments).
             if cfg.run.halt_on_nonfinite and not bool(_tree_finite(
-                    {"params": state["params"],
-                     "opt_state": state["opt_state"]})):
+                    {k: state[k] for k in
+                     ("params", "opt_state", "server_opt_state")
+                     if k in state})):
                 halt_diverged(f"params/optimizer state after round {rnd}",
                               rnd)
                 break

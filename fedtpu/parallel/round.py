@@ -36,9 +36,17 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from fedtpu.ops.metrics import confusion_matrix, metrics_from_confusion
+from fedtpu.ops.server_opt import (ServerOptimizer, clip_by_global_norm,
+                                   gaussian_noise_tree,
+                                   identity_server_optimizer)
 from fedtpu.parallel.mesh import CLIENTS_AXIS, client_sharding
 from fedtpu.parallel.ring import make_all_reduce
 from fedtpu.training.client import make_local_train_step, make_local_eval_step
+
+
+# PRNG domain-separation tag for the DP noise stream (vs the participation
+# stream, which folds the round index directly into key(participation_seed)).
+_DP_NOISE_STREAM = 0x6E6F6973  # "nois"
 
 
 def client_init_keys(key: jax.Array, num_clients: int, same_init: bool):
@@ -53,23 +61,39 @@ def client_init_keys(key: jax.Array, num_clients: int, same_init: bool):
 
 def init_federated_state(key: jax.Array, mesh, num_clients: int,
                          init_fn: Callable, tx: optax.GradientTransformation,
-                         same_init: bool = False):
+                         same_init: bool = False,
+                         server_opt: ServerOptimizer | None = None):
     """Per-client params + optimizer state, leading axis = clients, sharded.
 
     ``same_init=False`` matches the reference, where every rank constructs an
     independently-initialized torch model (FL_CustomMLP...:42 — unseeded, so
     ranks differ); here each client folds its index into the key instead, so
     the "different inits" are still reproducible.
+
+    ``server_opt`` (delta-based aggregation, fedtpu.ops.server_opt): the
+    server model is defined as the uniform mean of the client inits and every
+    client starts FROM it (server-state semantics — under delta aggregation
+    clients always begin a round at the global model), and the state gains a
+    replicated ``server_opt_state`` entry (momentum / second-moment pytrees).
     """
     params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
     opt_state = jax.vmap(tx.init)(params)
     shard = client_sharding(mesh)
     put = lambda t: jax.device_put(t, shard)
-    return {
+    state = {
         "params": jax.tree.map(put, params),
         "opt_state": jax.tree.map(put, opt_state),
         "round": jnp.zeros((), jnp.int32),
     }
+    if server_opt is not None:
+        from jax.sharding import NamedSharding
+        g0 = jax.tree.map(lambda p: p.mean(axis=0), params)
+        state["params"] = jax.tree.map(
+            lambda g, p: put(jnp.broadcast_to(g[None], p.shape)), g0, params)
+        replicated = NamedSharding(mesh, P())
+        state["server_opt_state"] = jax.tree.map(
+            lambda t: jax.device_put(t, replicated), server_opt.init(g0))
+    return state
 
 
 def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
@@ -79,7 +103,11 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                    participation_seed: int = 0,
                    aggregation: str = "psum",
                    local_steps: int = 1,
-                   prox_mu: float = 0.0):
+                   prox_mu: float = 0.0,
+                   server_opt: ServerOptimizer | None = None,
+                   dp_clip_norm: float = 0.0,
+                   dp_noise_multiplier: float = 0.0,
+                   dp_seed: int = 0):
     """Compile the full federated round. Returns
     ``round_step(state, batch) -> (state, metrics)`` where ``batch`` is a dict
     of client-sharded arrays ``x (C,N,...), y (C,N), mask (C,N)`` and
@@ -103,6 +131,25 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     the average; everyone still receives the new global params (server-state
     semantics). If a round samples zero participants, averaging is skipped
     and params carry over unchanged.
+
+    ``server_opt`` / ``dp_clip_norm`` / ``dp_noise_multiplier`` switch the
+    aggregation from parameter averaging to the DELTA path: the weighted mean
+    of client updates ``trained_i - g`` becomes a pseudo-gradient for a
+    server optimizer (FedOpt family, fedtpu.ops.server_opt), optionally
+    per-client L2-clipped to ``dp_clip_norm`` and perturbed with Gaussian
+    noise of std ``dp_noise_multiplier * dp_clip_norm / denominator``
+    (DP-FedAvg central DP). The denominator is the realized participant
+    weight at full participation; under client sampling it is the FIXED
+    public ``participation_rate * num_clients`` (and uniform weighting is
+    required) so sigma is not data-dependent — a zero-participant round then
+    still releases noise, which is the mechanism, not a bug. Under data-size
+    weighting the noise scale is heuristic; use ``weighting='uniform'`` for
+    standard sensitivity accounting. DP with no explicit server optimizer
+    applies the pure
+    averaging rule (fedavgm, momentum 0, lr 1 — exactly FedAvg on clipped,
+    noised deltas). State must come from ``init_federated_state`` with the
+    same ``server_opt`` so clients start at the server model and
+    ``server_opt_state`` exists.
     """
 
     local_train = make_local_train_step(apply_fn, tx, local_steps=local_steps,
@@ -118,7 +165,35 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     n_devices = mesh.devices.size
     all_reduce = make_all_reduce(aggregation, CLIENTS_AXIS, n_devices)
 
-    def round_body(params, opt_state, x, y, mask, rnd):
+    delta_path = (server_opt is not None or dp_clip_norm > 0
+                  or dp_noise_multiplier > 0)
+    if dp_noise_multiplier > 0 and dp_clip_norm <= 0:
+        raise ValueError("dp_noise_multiplier requires dp_clip_norm > 0 "
+                         "(noise std is noise_multiplier * clip / weight)")
+    if delta_path and server_opt is None:
+        # DP without an explicit server optimizer: pure averaging of the
+        # clipped, noised deltas == FedAvg (see fedtpu.ops.server_opt).
+        server_opt = identity_server_optimizer()
+    if delta_path and aggregation != "psum":
+        # The replicated server state rides psum's provable replication; an
+        # explicit ppermute ring can't be statically proven replicated for
+        # the P() out-spec below.
+        raise ValueError("server_opt / DP aggregation requires "
+                         "aggregation='psum'")
+    # DP + client sampling: the DP-FedAvg estimator divides by the FIXED
+    # public denominator q*C (expected participant weight), not the realized
+    # per-round total — otherwise sigma is data-dependent and no single
+    # (epsilon, delta) holds across rounds. Requires uniform weighting (the
+    # per-client sensitivity bound clip/denominator must be client-agnostic).
+    # Under the fixed denominator, zero-participant rounds still release
+    # noise — that IS the mechanism, not a bug.
+    dp_fixed_denom = dp_clip_norm > 0 and sampling
+    if dp_fixed_denom and weighting != "uniform":
+        raise ValueError("DP with partial participation requires "
+                         "weighting='uniform' (fixed public denominator "
+                         "q*C for the sensitivity accounting)")
+
+    def round_body(params, opt_state, sstate, x, y, mask, rnd):
         # Shapes here are per-device blocks: leading axis Cb = C / n_devices.
         # The batch is scan-invariant (full-batch training): close over it so
         # XLA treats it as a loop constant instead of threading it as carry.
@@ -128,7 +203,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
         gidx = jax.lax.axis_index(CLIENTS_AXIS) * cb + jnp.arange(cb)
 
         def one_round(carry, _):
-            params, opt_state, r = carry
+            params, opt_state, sstate, r = carry
+            start = params           # delta path: every slot holds the server model
             trained, new_opt, loss = jax.vmap(local_train)(
                 params, opt_state, x, y, mask)
 
@@ -158,45 +234,116 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 w = base_w
 
             conf = jax.vmap(local_eval)(params, x, y, mask)   # (Cb, K, K)
-            total_w = all_reduce(w.sum())                     # clients-varying
 
-            def avg(p):
-                # sum_i w_i * p_i locally, then all-reduce across devices ==
-                # the rank-0 gather + weighted average + bcast of
-                # FL_CustomMLP...:105-119.
-                local = jnp.tensordot(w.astype(jnp.float32),
-                                      p.astype(jnp.float32), axes=1)
-                glob = all_reduce(local) / jnp.maximum(total_w, 1.0)
-                out = jnp.broadcast_to(glob[None], p.shape).astype(p.dtype)
-                # Zero participants (possible under sampling): skip averaging.
-                return jnp.where(total_w > 0, out, p)
+            if delta_path:
+                # Weighted mean of per-client UPDATES as a pseudo-gradient
+                # for the server optimizer (fedtpu.ops.server_opt). Eval
+                # above ran on the trained local models, preserving the
+                # reference's metrics-before-aggregation order. Raw psum
+                # here — its result is axis-INVARIANT, unlike
+                # make_all_reduce's clients-varying typing — so the
+                # replicated server state provably stays replicated through
+                # the scan carry and the P() out-spec.
+                total_w = jax.lax.psum(w.sum(), CLIENTS_AXIS)
+                # Fixed public denominator q*C under DP+sampling (see the
+                # dp_fixed_denom note above); realized weight otherwise.
+                denom = (participation_rate * cb * n_devices
+                         if dp_fixed_denom else jnp.maximum(total_w, 1.0))
+                delta = jax.tree.map(lambda t, s: t - s, params, start)
+                if dp_clip_norm > 0:
+                    delta, _ = clip_by_global_norm(delta, dp_clip_norm)
 
-            params = jax.tree.map(avg, params)
+                def mean_delta_leaf(d):
+                    local = jnp.tensordot(w.astype(jnp.float32),
+                                          d.astype(jnp.float32), axes=1)
+                    return jax.lax.psum(local, CLIENTS_AXIS) / denom
+
+                mean_delta = jax.tree.map(mean_delta_leaf, delta)
+                if dp_noise_multiplier > 0:
+                    std = dp_noise_multiplier * dp_clip_norm / denom
+                    # Domain-separate the noise stream from the
+                    # participation stream (same fold_in(key(seed), r)
+                    # shape; both seeds default 0): fold a fixed tag in
+                    # first so the Gaussian draw is independent of the
+                    # participation coin flips.
+                    noise_key = jax.random.fold_in(
+                        jax.random.fold_in(jax.random.key(dp_seed),
+                                           _DP_NOISE_STREAM), r)
+                    mean_delta = jax.tree.map(
+                        jnp.add, mean_delta,
+                        gaussian_noise_tree(noise_key, mean_delta, std))
+                new_step, new_sstate = server_opt.update(mean_delta, sstate)
+                if sampling and not dp_fixed_denom:
+                    # Plain FedOpt under sampling: a zero-participant round
+                    # leaves the server model AND its momentum untouched
+                    # (params carry over unchanged, like the averaging path).
+                    keep = total_w > 0
+                    new_step = jax.tree.map(
+                        lambda s: jnp.where(keep, s, jnp.zeros_like(s)),
+                        new_step)
+                    new_sstate = jax.tree.map(
+                        lambda nv, ov: jnp.where(keep, nv, ov),
+                        new_sstate, sstate)
+                sstate = new_sstate
+                g = jax.tree.map(lambda s: s[0], start)   # slots identical
+                g_new = jax.tree.map(jnp.add, g, new_step)
+                params = jax.tree.map(
+                    lambda gl, p: jnp.broadcast_to(gl[None],
+                                                   p.shape).astype(p.dtype),
+                    g_new, params)
+            else:
+                total_w = all_reduce(w.sum())             # clients-varying
+
+                def avg(p):
+                    # sum_i w_i * p_i locally, then all-reduce across
+                    # devices == the rank-0 gather + weighted average +
+                    # bcast of FL_CustomMLP...:105-119.
+                    local = jnp.tensordot(w.astype(jnp.float32),
+                                          p.astype(jnp.float32), axes=1)
+                    glob = all_reduce(local) / jnp.maximum(total_w, 1.0)
+                    out = jnp.broadcast_to(glob[None],
+                                           p.shape).astype(p.dtype)
+                    # Zero participants (under sampling): skip averaging.
+                    return jnp.where(total_w > 0, out, p)
+
+                params = jax.tree.map(avg, params)
             pooled_conf = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)
-            return (params, opt_state, r + 1), (loss, conf, pooled_conf)
+            return (params, opt_state, sstate, r + 1), (loss, conf,
+                                                        pooled_conf)
 
-        (params, opt_state, _), stacked = jax.lax.scan(
-            one_round, (params, opt_state, rnd), length=rounds_per_step)
+        (params, opt_state, sstate, _), stacked = jax.lax.scan(
+            one_round, (params, opt_state, sstate, rnd),
+            length=rounds_per_step)
         loss, conf, pooled_conf = stacked        # leading axis = rounds R
-        return params, opt_state, loss, conf, pooled_conf
+        return params, opt_state, sstate, loss, conf, pooled_conf
 
     spec_c = P(CLIENTS_AXIS)
     spec_rc = P(None, CLIENTS_AXIS)              # (rounds, clients, ...)
     sharded_body = jax.shard_map(
         round_body, mesh=mesh,
-        in_specs=(spec_c, spec_c, spec_c, spec_c, spec_c, P()),
-        out_specs=(spec_c, spec_c, spec_rc, spec_rc, P()),
+        # sstate (server optimizer state) is replicated: it is derived only
+        # from all-reduced deltas, so every device computes it identically.
+        in_specs=(spec_c, spec_c, P(), spec_c, spec_c, spec_c, P()),
+        out_specs=(spec_c, spec_c, P(), spec_rc, spec_rc, P()),
     )
 
     @jax.jit
     def round_step(state, batch):
-        params, opt_state, loss, conf, pooled_conf = sharded_body(
-            state["params"], state["opt_state"],
+        if delta_path and "server_opt_state" not in state:
+            raise ValueError(
+                "delta aggregation (server_opt / DP) needs state from "
+                "init_federated_state(..., server_opt=...) — "
+                "'server_opt_state' missing")
+        sstate = state.get("server_opt_state", ())
+        params, opt_state, sstate, loss, conf, pooled_conf = sharded_body(
+            state["params"], state["opt_state"], sstate,
             batch["x"], batch["y"], batch["mask"], state["round"])
         metrics = assemble_metrics(loss, conf, pooled_conf, batch["mask"],
                                    rounds_per_step)
         new_state = {"params": params, "opt_state": opt_state,
                      "round": state["round"] + rounds_per_step}
+        if delta_path:
+            new_state["server_opt_state"] = sstate
         return new_state, metrics
 
     return round_step

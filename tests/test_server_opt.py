@@ -1,0 +1,310 @@
+"""Server-optimizer (FedOpt) + DP delta-aggregation tests.
+
+The reference's only aggregation is parameter averaging
+(FL_CustomMLP...:108-119); fedtpu generalizes it to a server optimizer over
+client deltas (fedtpu.ops.server_opt). The key invariant pinned here:
+``fedavgm(momentum=0, lr=1)`` on the delta path is EXACTLY parameter
+averaging, so the extension is a strict superset of the reference rule.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                           ModelConfig, OptimConfig, RunConfig, ShardConfig)
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.ops.server_opt import (clip_by_global_norm, make_server_optimizer)
+from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.parallel.round import build_round_fn, init_federated_state
+
+
+def _setup(server=None, num_clients=8, rows=200, lr=0.004,
+           weighting="data_size", **round_kw):
+    x, y = synthetic_income_like(rows, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=num_clients,
+                                            shuffle=False))
+    mesh = make_mesh(num_clients=num_clients)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig(learning_rate=lr))
+    state = init_federated_state(jax.random.key(1), mesh, num_clients,
+                                 init_fn, tx, same_init=True,
+                                 server_opt=server)
+    shard = client_sharding(mesh)
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    round_step = build_round_fn(mesh, apply_fn, tx, 2, weighting=weighting,
+                                server_opt=server, **round_kw)
+    return state, batch, round_step
+
+
+def _params0(state):
+    return jax.tree.map(lambda p: np.asarray(p)[0], state["params"])
+
+
+# ---------------------------------------------------------------- unit level
+
+def test_update_rules_match_numpy_oracle():
+    delta = {"w": jnp.array([1.0, -2.0]), "b": jnp.array([0.5])}
+    d = {k: np.asarray(v) for k, v in delta.items()}
+    lr, mom, b1, b2, tau = 0.5, 0.9, 0.9, 0.99, 1e-3
+
+    # fedavgm, two steps with the same delta.
+    opt = make_server_optimizer("fedavgm", learning_rate=lr, momentum=mom)
+    s = opt.init(delta)
+    step1, s = opt.update(delta, s)
+    step2, s = opt.update(delta, s)
+    for k in d:
+        np.testing.assert_allclose(step1[k], lr * d[k], rtol=1e-6)
+        np.testing.assert_allclose(step2[k], lr * (mom * d[k] + d[k]),
+                                   rtol=1e-6)
+
+    # fedadam.
+    opt = make_server_optimizer("fedadam", learning_rate=lr, b1=b1, b2=b2,
+                                tau=tau)
+    s = opt.init(delta)
+    step1, s = opt.update(delta, s)
+    for k in d:
+        m = (1 - b1) * d[k]
+        v = (1 - b2) * d[k] ** 2
+        np.testing.assert_allclose(step1[k], lr * m / (np.sqrt(v) + tau),
+                                   rtol=1e-5)
+
+    # fedadagrad accumulates the raw square.
+    opt = make_server_optimizer("fedadagrad", learning_rate=lr, b1=b1,
+                                tau=tau)
+    s = opt.init(delta)
+    _, s = opt.update(delta, s)
+    _, s = opt.update(delta, s)
+    for k in d:
+        np.testing.assert_allclose(s["v"][k], 2 * d[k] ** 2, rtol=1e-6)
+
+    # fedyogi second moment: v - (1-b2) d^2 sign(v - d^2), from v=0.
+    opt = make_server_optimizer("fedyogi", learning_rate=lr, b1=b1, b2=b2,
+                                tau=tau)
+    s = opt.init(delta)
+    _, s = opt.update(delta, s)
+    for k in d:
+        np.testing.assert_allclose(s["v"][k],
+                                   -(1 - b2) * d[k] ** 2 * np.sign(-d[k] ** 2),
+                                   rtol=1e-6)
+
+
+def test_clip_by_global_norm_is_per_client_joint():
+    delta = {"w": jnp.array([[3.0, 4.0], [0.3, 0.4]]),  # norms 5, then joint
+             "b": jnp.array([[0.0], [0.0]])}
+    clipped, norms = clip_by_global_norm(delta, 1.0)
+    np.testing.assert_allclose(norms, [5.0, 0.5], rtol=1e-6)
+    # client 0 scaled by 1/5 (joint norm across BOTH leaves), client 1 intact.
+    np.testing.assert_allclose(clipped["w"][0], [0.6, 0.8], rtol=1e-6)
+    np.testing.assert_allclose(clipped["w"][1], [0.3, 0.4], rtol=1e-6)
+
+
+def test_unknown_server_opt_rejected():
+    import pytest
+    with pytest.raises(ValueError, match="unknown server optimizer"):
+        make_server_optimizer("sgd")
+
+
+# ----------------------------------------------------------- round-fn level
+
+def test_fedavgm_identity_point_is_exactly_fedavg():
+    # momentum=0, lr=1 on the delta path == parameter averaging: pinned
+    # against the vanilla engine path, 3 rounds, same init and data.
+    vanilla_state, batch, vanilla_step = _setup(server=None)
+    ident = make_server_optimizer("fedavgm", learning_rate=1.0, momentum=0.0)
+    delta_state, _, delta_step = _setup(server=ident)
+
+    for _ in range(3):
+        vanilla_state, _ = vanilla_step(vanilla_state, batch)
+        delta_state, _ = delta_step(delta_state, batch)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-5),
+        vanilla_state["params"], delta_state["params"])
+
+
+def test_fedadam_trains_and_carries_server_state():
+    server = make_server_optimizer("fedadam", learning_rate=0.03)
+    state, batch, step = _setup(server=server)
+    accs = []
+    for _ in range(10):
+        state, metrics = step(state, batch)
+        accs.append(float(metrics["client_mean"]["accuracy"]))
+    assert "server_opt_state" in state
+    m_leaves = jax.tree.leaves(state["server_opt_state"]["m"])
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in m_leaves)
+    assert any(np.abs(np.asarray(l)).max() > 0 for l in m_leaves)
+    assert accs[-1] > 0.5  # learned something on separable synthetic data
+    # All client slots hold the identical server model.
+    p = np.asarray(jax.tree.leaves(state["params"])[0])
+    np.testing.assert_allclose(p, np.broadcast_to(p[:1], p.shape), atol=0)
+
+
+def test_server_path_inside_multi_round_scan():
+    server = make_server_optimizer("fedyogi", learning_rate=0.02)
+    state, batch, step = _setup(server=server, rounds_per_step=4)
+    state, metrics = step(state, batch)
+    assert metrics["client_mean"]["accuracy"].shape == (4,)
+    assert int(state["round"]) == 4
+    assert "server_opt_state" in state
+
+
+def test_missing_server_state_is_a_clear_error():
+    import pytest
+    server = make_server_optimizer("fedadam")
+    state, batch, _ = _setup(server=None)          # state WITHOUT server init
+    _, _, step = _setup(server=server)
+    with pytest.raises(ValueError, match="server_opt_state"):
+        step(state, batch)
+
+
+def test_delta_path_rejects_ring_aggregation():
+    import pytest
+    with pytest.raises(ValueError, match="psum"):
+        _setup(server=make_server_optimizer("fedadam"), aggregation="ring")
+
+
+# ------------------------------------------------------------------ DP level
+
+def test_dp_huge_clip_no_noise_is_plain_fedavg():
+    vanilla_state, batch, vanilla_step = _setup(server=None)
+    ident = make_server_optimizer("fedavgm", learning_rate=1.0, momentum=0.0)
+    dp_state, _, dp_step = _setup(server=ident, dp_clip_norm=1e9)
+    for _ in range(2):
+        vanilla_state, _ = vanilla_step(vanilla_state, batch)
+        dp_state, _ = dp_step(dp_state, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-5),
+        vanilla_state["params"], dp_state["params"])
+
+
+def test_dp_clip_bounds_global_step():
+    # With lr=1, no momentum, no noise: ||g1 - g0|| <= clip (each client's
+    # delta is clipped to `clip`, and a convex combination can't exceed it).
+    clip = 1e-3
+    ident = make_server_optimizer("fedavgm", learning_rate=1.0, momentum=0.0)
+    state, batch, step = _setup(server=ident, dp_clip_norm=clip)
+    g0 = _params0(state)
+    state, _ = step(state, batch)
+    g1 = _params0(state)
+    moved = np.sqrt(sum(np.sum((a - b) ** 2) for a, b in
+                        zip(jax.tree.leaves(g1), jax.tree.leaves(g0))))
+    assert moved <= clip * (1 + 1e-5)
+
+
+def test_dp_noise_is_seed_deterministic():
+    ident = make_server_optimizer("fedavgm", learning_rate=1.0, momentum=0.0)
+    runs = {}
+    for seed in (0, 0, 7):
+        state, batch, step = _setup(server=ident, dp_clip_norm=0.1,
+                                    dp_noise_multiplier=0.5, dp_seed=seed)
+        state, _ = step(state, batch)
+        runs.setdefault(seed, []).append(_params0(state))
+    a, b = runs[0]
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(x, y), a, b)
+    c = runs[7][0]
+    diffs = [np.abs(x - y).max() for x, y in
+             zip(jax.tree.leaves(a), jax.tree.leaves(c))]
+    assert max(diffs) > 0  # different seed, different noise
+
+
+def test_zero_participant_round_leaves_server_untouched():
+    # Plain FedOpt (no DP) under sampling: participation_rate ~ 0 makes
+    # every round empty — the server model AND its momentum must not move.
+    server = make_server_optimizer("fedavgm", learning_rate=1.0,
+                                   momentum=0.9)
+    state, batch, step = _setup(server=server, participation_rate=1e-9)
+    g0 = _params0(state)
+    m0 = jax.tree.map(np.asarray, jax.device_get(state["server_opt_state"]))
+    state, _ = step(state, batch)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 g0, _params0(state))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), m0,
+                 jax.tree.map(np.asarray,
+                              jax.device_get(state["server_opt_state"])))
+
+
+def test_dp_with_sampling_uses_fixed_denominator():
+    # DP + sampling: sigma rides the PUBLIC q*C denominator, so even an
+    # empty round releases noise (the mechanism, not a bug) — params move.
+    ident = make_server_optimizer("fedavgm", learning_rate=1.0, momentum=0.0)
+    state, batch, step = _setup(server=ident, weighting="uniform",
+                                dp_clip_norm=0.5, dp_noise_multiplier=1.0,
+                                participation_rate=1e-9)
+    g0 = _params0(state)
+    state, _ = step(state, batch)
+    g1 = _params0(state)
+    diffs = [np.abs(a - b).max() for a, b in
+             zip(jax.tree.leaves(g1), jax.tree.leaves(g0))]
+    assert max(diffs) > 0
+
+
+def test_dp_with_sampling_rejects_data_size_weighting():
+    import pytest
+    ident = make_server_optimizer("fedavgm", learning_rate=1.0, momentum=0.0)
+    with pytest.raises(ValueError, match="uniform"):
+        _setup(server=ident, weighting="data_size", dp_clip_norm=0.5,
+               participation_rate=0.5)
+
+
+def test_2d_engine_rejects_noise_only_dp():
+    import pytest
+    from fedtpu.orchestration.loop import build_experiment
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=128,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=4),
+        model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
+        fed=FedConfig(dp_noise_multiplier=1.0),
+        run=RunConfig(model_parallel=2),
+    )
+    with pytest.raises(ValueError, match="1-D engine"):
+        build_experiment(cfg)
+
+
+def test_dp_noise_requires_clip():
+    import pytest
+    with pytest.raises(ValueError, match="dp_clip_norm"):
+        _setup(server=None, dp_noise_multiplier=1.0)
+
+
+# ------------------------------------------------------------ loop-level e2e
+
+def test_run_experiment_with_fedadam_and_dp():
+    from fedtpu.orchestration.loop import run_experiment
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=8, shuffle=False),
+        model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
+        optim=OptimConfig(),
+        fed=FedConfig(rounds=6, server_opt="fedadam", server_lr=0.02,
+                      dp_clip_norm=1.0, dp_noise_multiplier=0.01,
+                      weighting="uniform"),
+        run=RunConfig(rounds_per_step=3),
+    )
+    result = run_experiment(cfg, verbose=False)
+    assert result.rounds_run == 6
+    assert all(np.isfinite(v) for v in result.global_metrics["accuracy"])
+
+
+def test_2d_engine_rejects_server_opt():
+    import pytest
+    from fedtpu.orchestration.loop import build_experiment
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=128,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=4),
+        model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
+        fed=FedConfig(server_opt="fedadam"),
+        run=RunConfig(model_parallel=2),
+    )
+    with pytest.raises(ValueError, match="1-D engine"):
+        build_experiment(cfg)
