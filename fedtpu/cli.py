@@ -89,6 +89,10 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    default=None,
                    help="Gaussian noise multiplier on the averaged clipped "
                         "delta (needs --dp-clip-norm > 0)")
+    p.add_argument("--compress", choices=["none", "int8"], default=None,
+                   help="int8-quantize the update exchange (D/8 of the f32 "
+                        "psum traffic at D devices; for few-host DCN-bound "
+                        "aggregation)")
     p.add_argument("--shard-strategy",
                    choices=["contiguous", "label_sort", "dirichlet"],
                    default=None)
@@ -158,6 +162,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
     if args.dp_noise_multiplier is not None:
         fed = dataclasses.replace(fed,
                                   dp_noise_multiplier=args.dp_noise_multiplier)
+    if args.compress is not None:
+        fed = dataclasses.replace(fed, compress=args.compress)
     run_kw = {}
     if args.checkpoint_dir is not None:
         run_kw["checkpoint_dir"] = args.checkpoint_dir

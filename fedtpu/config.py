@@ -165,6 +165,13 @@ class FedConfig:
     dp_clip_norm: float = 0.0
     dp_noise_multiplier: float = 0.0
     dp_seed: int = 0
+    # Quantized update exchange (fedtpu.parallel.compress): 'none' | 'int8'
+    # — per-device weighted partial sums quantized to int8 and all-gathered.
+    # Received bytes are D/8 of the exact f32 psum path's (D = devices on
+    # the axis): a win for few-host DCN aggregation (2-8 hosts), the regime
+    # it targets; at large D plain psum wins, hence default 'none'. Plain
+    # averaging only (not server_opt/DP); aggregation='psum'; 1-D engine.
+    compress: str = "none"
     # Each client starts from an independent random init, matching the
     # reference where every rank constructs an unseeded torch model
     # (FL_CustomMLP...:42). Set True to start all clients identical.

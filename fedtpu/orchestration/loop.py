@@ -136,6 +136,9 @@ def build_experiment(cfg: ExperimentConfig,
                 or cfg.fed.dp_noise_multiplier > 0):
             raise ValueError("server_opt / DP aggregation requires the 1-D "
                              "engine (model_parallel=1)")
+        if cfg.fed.compress != "none":
+            raise ValueError("compressed aggregation requires the 1-D "
+                             "engine (model_parallel=1)")
         # Only dims the tp specs actually place on the 'model' axis need to
         # divide: the col-sharded out-dims (even indices — row layers shard
         # the PREVIOUS layer's out-dim, already covered) plus, for convnets,
@@ -177,7 +180,8 @@ def build_experiment(cfg: ExperimentConfig,
             server = identity_server_optimizer()
         state_fn = lambda: init_federated_state(
             jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
-            init_fn, tx, same_init=cfg.fed.same_init, server_opt=server)
+            init_fn, tx, same_init=cfg.fed.same_init, server_opt=server,
+            shared_start=cfg.fed.compress != "none")
         step_fn = lambda r: build_round_fn(
             mesh, apply_fn, tx, ds.num_classes, weighting=cfg.fed.weighting,
             rounds_per_step=r,
@@ -189,7 +193,8 @@ def build_experiment(cfg: ExperimentConfig,
             server_opt=server,
             dp_clip_norm=cfg.fed.dp_clip_norm,
             dp_noise_multiplier=cfg.fed.dp_noise_multiplier,
-            dp_seed=cfg.fed.dp_seed)
+            dp_seed=cfg.fed.dp_seed,
+            compress=cfg.fed.compress)
 
     batch = {
         "x": jax.device_put(packed.x, shard),

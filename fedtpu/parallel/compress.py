@@ -1,0 +1,88 @@
+"""Quantized (int8) client-update exchange for bandwidth-limited links.
+
+The reference ships every client's FULL float weights through rank 0 as
+pickled bytes every round (FL_CustomMLPCLassifierImplementation_Multiple_
+Rounds.py:103-119). On a TPU pod slice the equivalent exchange rides ICI,
+where bandwidth is plentiful — but across HOSTS (DCN, the `mpirun` analogue,
+fedtpu.parallel.multihost) the wire is the bottleneck, and the standard FL
+remedy is update compression.
+
+Scheme: each device first reduces its OWN clients locally (the weighted
+partial sum ``S_d = sum_{c on d} w_c * delta_c`` — one tensor per leaf, no
+client axis), then quantizes that partial sum to int8 with one scalar scale
+per tensor, and all-gathers the int8 payloads:
+
+    scale_d  = max|S_d| / 127                     one f32 scalar per tensor
+    q_d      = round(S_d / scale_d)               int8 in [-127, 127]
+    exchange all_gather(q), all_gather(scale)     <- the wire (int8 + scalars)
+    mean     = sum_d q_d * scale_d / total_w
+
+Wire accounting per device, for a tensor of N elements over D devices:
+the int8 all_gather receives ``(D-1) * N`` bytes, while the exact f32 psum
+path (which reduce-scatters+all-gathers f32) receives ``~8N * (D-1)/D`` —
+a traffic ratio of ``D/8``. The win regime is exactly the one this targets:
+few-host DCN aggregation (2-8 hosts; at 4 hosts, half the f32-psum bytes,
+and always 4x less than the same all-gather exchange in f32). Quantization
+is not summable in transit (requantizing at every hop compounds error), so
+an all-gather-based exchange is the standard shape for compressed
+aggregation; at large D prefer plain psum — XLA's f32 reduction wins there,
+which is why ``compress='none'`` stays the default.
+
+Error: at most ``scale_d / 2`` per element of each partial sum — half an
+int8 step of the device's largest summed-delta element; per-round deltas
+are Adam-step sized, so the relative error is tiny. ``tests/test_compress.py``
+pins the unit bound and end-to-end trajectory parity with the exact path.
+
+This composes with the plain-averaging aggregation only (not the
+server-opt/DP delta path): the gathered result is clients-varying typed
+under shard_map, which the replicated server-state carry there cannot
+accept, and DP noise calibration assumes exact (unquantized) sensitivity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_tensor(x: jax.Array):
+    """Symmetric int8 quantization with one scalar scale for the whole
+    tensor. Returns ``(q int8, scale f32 scalar)``; an all-zero tensor gets
+    scale 0 and dequantizes to exact zeros."""
+    maxabs = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    scale = maxabs / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / safe),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q: jax.Array, scale: jax.Array):
+    """Inverse of :func:`quantize_tensor`, broadcasting ``scale`` over the
+    trailing axes of ``q`` (for gathered payloads ``scale`` carries the
+    leading device axis)."""
+    shape = scale.shape + (1,) * (q.ndim - scale.ndim)
+    return q.astype(jnp.float32) * scale.reshape(shape)
+
+
+def make_quantized_weighted_mean(axis_name: str):
+    """Returns ``qmean(delta, w, total_w) -> mean_delta`` computing the
+    weighted mean of per-client deltas across the mesh with int8 payloads on
+    the wire (see module docstring for the schedule and wire math). Must run
+    inside shard_map over ``axis_name``; ``delta`` leaves are ``(Cb, ...)``
+    per-device client blocks, ``w`` is ``(Cb,)``, and ``total_w`` the
+    all-reduced weight sum (clients-varying, like the result)."""
+
+    def qmean_leaf(d, wf):
+        partial = jnp.tensordot(wf, d.astype(jnp.float32), axes=1)
+        q, scale = quantize_tensor(partial)
+        qg = jax.lax.all_gather(q, axis_name)        # (D, ...) int8 wire
+        sg = jax.lax.all_gather(scale, axis_name)    # (D,) f32 scalars
+        return dequantize(qg, sg).sum(axis=0)
+
+    def qmean(delta, w, total_w):
+        wf = w.astype(jnp.float32)
+        denom = jnp.maximum(total_w, 1.0)
+        return jax.tree.map(lambda d: qmean_leaf(d, wf) / denom, delta)
+
+    return qmean

@@ -39,6 +39,7 @@ from fedtpu.ops.metrics import confusion_matrix, metrics_from_confusion
 from fedtpu.ops.server_opt import (ServerOptimizer, clip_by_global_norm,
                                    gaussian_noise_tree,
                                    identity_server_optimizer)
+from fedtpu.parallel.compress import make_quantized_weighted_mean
 from fedtpu.parallel.mesh import CLIENTS_AXIS, client_sharding
 from fedtpu.parallel.ring import make_all_reduce
 from fedtpu.training.client import make_local_train_step, make_local_eval_step
@@ -62,7 +63,8 @@ def client_init_keys(key: jax.Array, num_clients: int, same_init: bool):
 def init_federated_state(key: jax.Array, mesh, num_clients: int,
                          init_fn: Callable, tx: optax.GradientTransformation,
                          same_init: bool = False,
-                         server_opt: ServerOptimizer | None = None):
+                         server_opt: ServerOptimizer | None = None,
+                         shared_start: bool = False):
     """Per-client params + optimizer state, leading axis = clients, sharded.
 
     ``same_init=False`` matches the reference, where every rank constructs an
@@ -75,6 +77,11 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
     client starts FROM it (server-state semantics — under delta aggregation
     clients always begin a round at the global model), and the state gains a
     replicated ``server_opt_state`` entry (momentum / second-moment pytrees).
+
+    ``shared_start`` (without a server optimizer) likewise starts every
+    client from the uniform mean of the inits — required by aggregations
+    that reconstruct the new global as ``start + mean(delta)`` (the int8
+    compressed exchange, fedtpu.parallel.compress).
     """
     params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
     opt_state = jax.vmap(tx.init)(params)
@@ -85,11 +92,17 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
         "opt_state": jax.tree.map(put, opt_state),
         "round": jnp.zeros((), jnp.int32),
     }
-    if server_opt is not None:
-        from jax.sharding import NamedSharding
+    if server_opt is not None or shared_start:
         g0 = jax.tree.map(lambda p: p.mean(axis=0), params)
         state["params"] = jax.tree.map(
             lambda g, p: put(jnp.broadcast_to(g[None], p.shape)), g0, params)
+        # Leafless structural marker: build_round_fn's compressed path can
+        # fail fast when handed a state whose slots never started shared
+        # (dict membership is static under jit; no runtime cost).
+        state["shared_start"] = ()
+    if server_opt is not None:
+        from jax.sharding import NamedSharding
+        g0 = jax.tree.map(lambda p: p[0], state["params"])
         replicated = NamedSharding(mesh, P())
         state["server_opt_state"] = jax.tree.map(
             lambda t: jax.device_put(t, replicated), server_opt.init(g0))
@@ -107,7 +120,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                    server_opt: ServerOptimizer | None = None,
                    dp_clip_norm: float = 0.0,
                    dp_noise_multiplier: float = 0.0,
-                   dp_seed: int = 0):
+                   dp_seed: int = 0,
+                   compress: str = "none"):
     """Compile the full federated round. Returns
     ``round_step(state, batch) -> (state, metrics)`` where ``batch`` is a dict
     of client-sharded arrays ``x (C,N,...), y (C,N), mask (C,N)`` and
@@ -192,6 +206,20 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
         raise ValueError("DP with partial participation requires "
                          "weighting='uniform' (fixed public denominator "
                          "q*C for the sensitivity accounting)")
+    if compress not in ("none", "int8"):
+        raise ValueError(f"unknown compress mode {compress!r}; "
+                         "available: 'none', 'int8'")
+    if compress != "none" and delta_path:
+        # The quantized exchange's all_gather result is clients-varying
+        # typed, which the replicated server-state carry cannot accept; DP
+        # noise calibration also assumes exact (unquantized) sensitivity.
+        raise ValueError("compress composes with plain averaging only, not "
+                         "server_opt / DP aggregation")
+    if compress != "none" and aggregation != "psum":
+        raise ValueError("compress replaces the reduction; use "
+                         "aggregation='psum' with it")
+    qmean = (make_quantized_weighted_mean(CLIENTS_AXIS)
+             if compress == "int8" else None)
 
     def round_body(params, opt_state, sstate, x, y, mask, rnd):
         # Shapes here are per-device blocks: leading axis Cb = C / n_devices.
@@ -291,6 +319,24 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     lambda gl, p: jnp.broadcast_to(gl[None],
                                                    p.shape).astype(p.dtype),
                     g_new, params)
+            elif compress == "int8":
+                # Bandwidth-lean exchange (fedtpu.parallel.compress): the
+                # new global is reconstructed as start + weighted-mean of
+                # int8-quantized deltas; requires every slot to start the
+                # round at the shared global (init_federated_state
+                # shared_start=True), like the delta path.
+                total_w = all_reduce(w.sum())             # clients-varying
+                delta = jax.tree.map(lambda t, s: t - s, params, start)
+                mean_delta = qmean(delta, w.astype(jnp.float32), total_w)
+                g = jax.tree.map(lambda s: s[0], start)   # slots identical
+
+                def q_avg(gl, md, p):
+                    out = jnp.broadcast_to((gl + md)[None],
+                                           p.shape).astype(p.dtype)
+                    # Zero participants (under sampling): skip averaging.
+                    return jnp.where(total_w > 0, out, p)
+
+                params = jax.tree.map(q_avg, g, mean_delta, params)
             else:
                 total_w = all_reduce(w.sum())             # clients-varying
 
@@ -334,6 +380,12 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 "delta aggregation (server_opt / DP) needs state from "
                 "init_federated_state(..., server_opt=...) — "
                 "'server_opt_state' missing")
+        if compress != "none" and "shared_start" not in state:
+            raise ValueError(
+                "compressed aggregation reconstructs the global as "
+                "start + mean(delta), which needs every client slot to "
+                "start the round at the shared global — build the state "
+                "with init_federated_state(..., shared_start=True)")
         sstate = state.get("server_opt_state", ())
         params, opt_state, sstate, loss, conf, pooled_conf = sharded_body(
             state["params"], state["opt_state"], sstate,
@@ -344,6 +396,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                      "round": state["round"] + rounds_per_step}
         if delta_path:
             new_state["server_opt_state"] = sstate
+        if "shared_start" in state:
+            new_state["shared_start"] = ()
         return new_state, metrics
 
     return round_step

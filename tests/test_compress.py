@@ -1,0 +1,169 @@
+"""int8-quantized update exchange (fedtpu.parallel.compress): unit error
+bounds + end-to-end parity with the exact f32 averaging path."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                           ModelConfig, OptimConfig, RunConfig, ShardConfig)
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.parallel.compress import dequantize, quantize_tensor
+from fedtpu.parallel.round import build_round_fn, init_federated_state
+
+
+# ---------------------------------------------------------------- unit level
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.normal(size=(37, 5)).astype(np.float32))
+    q, scale = quantize_tensor(d)
+    assert q.dtype == jnp.int8
+    back = dequantize(q, scale)
+    # Round-to-nearest: error <= scale/2 per element.
+    err = np.abs(np.asarray(back) - np.asarray(d))
+    assert np.all(err <= float(scale) / 2 * (1 + 1e-6))
+
+
+def test_quantize_zero_delta_is_exact():
+    d = jnp.zeros((3, 8))
+    q, scale = quantize_tensor(d)
+    assert float(scale) == 0.0
+    np.testing.assert_array_equal(np.asarray(dequantize(q, scale)), 0.0)
+
+
+def test_quantize_preserves_extremes():
+    # The max-magnitude element maps to exactly +-127 and dequantizes back
+    # to its original value.
+    d = jnp.asarray([0.5, -2.0, 1.0])
+    q, scale = quantize_tensor(d)
+    assert int(q[1]) == -127
+    back = np.asarray(dequantize(q, scale))
+    np.testing.assert_allclose(back[1], -2.0, rtol=1e-6)
+
+
+def test_dequantize_broadcasts_gathered_scales():
+    # Gathered payloads carry a leading device axis on q AND scale.
+    q = jnp.asarray([[10, -20], [30, 40]], jnp.int8)
+    scale = jnp.asarray([0.1, 0.2])
+    out = np.asarray(dequantize(q, scale))
+    np.testing.assert_allclose(out, [[1.0, -2.0], [6.0, 8.0]], rtol=1e-6)
+
+
+# ----------------------------------------------------------- round-fn level
+
+def _setup(compress="none", num_clients=8, rows=200, lr=0.004, **round_kw):
+    x, y = synthetic_income_like(rows, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=num_clients,
+                                            shuffle=False))
+    mesh = make_mesh(num_clients=num_clients)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig(learning_rate=lr))
+    state = init_federated_state(jax.random.key(1), mesh, num_clients,
+                                 init_fn, tx, same_init=True,
+                                 shared_start=compress != "none")
+    shard = client_sharding(mesh)
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    round_step = build_round_fn(mesh, apply_fn, tx, 2, compress=compress,
+                                **round_kw)
+    return state, batch, round_step
+
+
+def test_compressed_round_tracks_exact_averaging():
+    exact_state, batch, exact_step = _setup(compress="none")
+    q_state, _, q_step = _setup(compress="int8")
+    for _ in range(5):
+        exact_state, em = exact_step(exact_state, batch)
+        q_state, qm = q_step(q_state, batch)
+    # Per-round quantization error is <= half an int8 step of the largest
+    # delta element; after 5 rounds the params should still track closely.
+    for a, b in zip(jax.tree.leaves(exact_state["params"]),
+                    jax.tree.leaves(q_state["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+    assert abs(float(em["client_mean"]["accuracy"])
+               - float(qm["client_mean"]["accuracy"])) < 0.05
+
+
+def test_compressed_zero_lr_is_bit_exact():
+    # lr=0 -> all deltas are exactly zero -> quantization is lossless and
+    # the round is a no-op on params.
+    state, batch, step = _setup(compress="int8", lr=0.0)
+    before = jax.tree.map(np.asarray, jax.device_get(state["params"]))
+    state, _ = step(state, batch)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+                 before, state["params"])
+
+
+def test_compressed_inside_multi_round_scan():
+    state, batch, step = _setup(compress="int8", rounds_per_step=3)
+    state, metrics = step(state, batch)
+    assert metrics["client_mean"]["accuracy"].shape == (3,)
+    assert int(state["round"]) == 3
+
+
+def test_compressed_with_participation_sampling():
+    state, batch, step = _setup(compress="int8", participation_rate=0.5)
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["client_mean"]["accuracy"]))
+    # Slots stay identical (the broadcast global) under sampling too.
+    p = np.asarray(jax.tree.leaves(state["params"])[0])
+    np.testing.assert_allclose(p, np.broadcast_to(p[:1], p.shape), atol=0)
+
+
+def test_compress_rejects_delta_path_and_ring():
+    from fedtpu.ops.server_opt import make_server_optimizer
+    with pytest.raises(ValueError, match="plain averaging only"):
+        _setup(compress="int8", server_opt=make_server_optimizer("fedadam"))
+    with pytest.raises(ValueError, match="psum"):
+        _setup(compress="int8", aggregation="ring")
+    with pytest.raises(ValueError, match="unknown compress"):
+        _setup(compress="int4")
+
+
+def test_compress_rejects_state_without_shared_start():
+    # start + mean(delta) is only the weighted mean when all slots start at
+    # the shared global; a plain state must be refused, not silently wrong.
+    plain_state, batch, _ = _setup(compress="none")
+    _, _, q_step = _setup(compress="int8")
+    with pytest.raises(ValueError, match="shared_start"):
+        q_step(plain_state, batch)
+
+
+# ------------------------------------------------------------ loop-level e2e
+
+def test_run_experiment_with_compression():
+    from fedtpu.orchestration.loop import run_experiment
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=8, shuffle=False),
+        model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
+        optim=OptimConfig(),
+        fed=FedConfig(rounds=6, compress="int8"),
+        run=RunConfig(rounds_per_step=2),
+    )
+    result = run_experiment(cfg, verbose=False)
+    assert result.rounds_run == 6
+    assert all(np.isfinite(v) for v in result.global_metrics["accuracy"])
+
+
+def test_2d_engine_rejects_compression():
+    from fedtpu.orchestration.loop import build_experiment
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=128,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=4),
+        model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
+        fed=FedConfig(compress="int8"),
+        run=RunConfig(model_parallel=2),
+    )
+    with pytest.raises(ValueError, match="1-D engine"):
+        build_experiment(cfg)
