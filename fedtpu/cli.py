@@ -93,6 +93,16 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    help="int8-quantize the update exchange (D/8 of the f32 "
                         "psum traffic at D devices; for few-host DCN-bound "
                         "aggregation)")
+    p.add_argument("--robust-aggregation",
+                   choices=["none", "median", "trimmed_mean"], default=None,
+                   help="Byzantine-robust aggregation rule (requires "
+                        "--weighting uniform and full participation)")
+    p.add_argument("--trim-ratio", type=_nonnegative_float, default=None,
+                   help="fraction trimmed from each end per coordinate "
+                        "(trimmed_mean)")
+    p.add_argument("--byzantine-clients", type=int, default=None,
+                   help="fault injection: first k clients submit 10x "
+                        "sign-flipped updates")
     p.add_argument("--shard-strategy",
                    choices=["contiguous", "label_sort", "dirichlet"],
                    default=None)
@@ -164,6 +174,14 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
                                   dp_noise_multiplier=args.dp_noise_multiplier)
     if args.compress is not None:
         fed = dataclasses.replace(fed, compress=args.compress)
+    if args.robust_aggregation is not None:
+        fed = dataclasses.replace(fed,
+                                  robust_aggregation=args.robust_aggregation)
+    if args.trim_ratio is not None:
+        fed = dataclasses.replace(fed, trim_ratio=args.trim_ratio)
+    if args.byzantine_clients is not None:
+        fed = dataclasses.replace(fed,
+                                  byzantine_clients=args.byzantine_clients)
     run_kw = {}
     if args.checkpoint_dir is not None:
         run_kw["checkpoint_dir"] = args.checkpoint_dir

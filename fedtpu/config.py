@@ -165,6 +165,16 @@ class FedConfig:
     dp_clip_norm: float = 0.0
     dp_noise_multiplier: float = 0.0
     dp_seed: int = 0
+    # Byzantine-robust aggregation: 'none' (weighted mean — the reference's
+    # rule) | 'median' (coordinate-wise) | 'trimmed_mean' (drop trim_ratio
+    # from each end per coordinate). Order statistics are unweighted, so
+    # weighting='uniform' is required (making the semantics explicit); full
+    # participation + plain psum path only. byzantine_clients injects k
+    # model-poisoning clients (10x sign-flipped updates) as the matching
+    # fault injection.
+    robust_aggregation: str = "none"
+    trim_ratio: float = 0.1
+    byzantine_clients: int = 0
     # Quantized update exchange (fedtpu.parallel.compress): 'none' | 'int8'
     # — per-device weighted partial sums quantized to int8 and all-gathered.
     # Received bytes are D/8 of the exact f32 psum path's (D = devices on

@@ -28,14 +28,31 @@ def _ckpt_path(directory: str, step: int) -> str:
     return os.path.join(os.path.abspath(directory), f"round_{step:06d}")
 
 
+def _strip_marker(state):
+    """Drop the leafless 'shared_start' marker (fedtpu.parallel.round) from
+    a state dict. The marker records how the LIVE state was constructed —
+    config, not data — so it is never persisted; keeping it out of the
+    on-disk tree also keeps checkpoints written before the marker existed
+    restorable (orbax rejects template/on-disk structure mismatches)."""
+    if isinstance(state, dict) and "shared_start" in state:
+        state = {k: v for k, v in state.items() if k != "shared_start"}
+    return state
+
+
 def save_checkpoint(directory: str, state, history: dict, step: int) -> str:
-    """Write state + {history, step} under ``directory/round_<step>``."""
+    """Write state + {history, step, num_clients} under
+    ``directory/round_<step>``. ``num_clients`` lives in the tiny meta item
+    so elastic-resume detection (fedtpu.orchestration.loop) never has to
+    read the full state twice on the common same-count path."""
     path = _ckpt_path(directory, step)
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(os.path.join(path, "state"), to_numpy(state), force=True)
+    ckptr.save(os.path.join(path, "state"), to_numpy(_strip_marker(state)),
+               force=True)
+    num_clients = jax.tree.leaves(state["params"])[0].shape[0]
     ckptr.save(os.path.join(path, "meta"),
                {"history": {k: np.asarray(v) for k, v in history.items()},
-                "step": np.asarray(step)},
+                "step": np.asarray(step),
+                "num_clients": np.asarray(num_clients)},
                force=True)
     return path
 
@@ -51,6 +68,46 @@ def latest_step(directory: str) -> Optional[int]:
             except (IndexError, ValueError):
                 continue
     return max(steps) if steps else None
+
+
+def load_checkpoint_raw(directory: str, step: Optional[int] = None
+                        ) -> Tuple[dict, dict, int]:
+    """Read back ``(state, history, step)`` WITHOUT a restore template:
+    plain nested dicts/lists of numpy arrays (optax namedtuples come back as
+    dicts). Used by elastic resume (fedtpu.orchestration.loop), which needs
+    the saved arrays under a DIFFERENT client count than the live state —
+    a typed template restore would reject the shape mismatch."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = _ckpt_path(directory, step)
+    ckptr = ocp.PyTreeCheckpointer()
+    state = ckptr.restore(os.path.join(path, "state"))
+    meta = ckptr.restore(os.path.join(path, "meta"))
+    history = {k: list(np.asarray(v)) for k, v in meta["history"].items()}
+    return state, history, int(np.asarray(meta["step"]))
+
+
+def saved_num_clients(raw_state: dict) -> int:
+    """Client count of a raw checkpoint: the leading axis every params leaf
+    carries."""
+    return int(jax.tree.leaves(raw_state["params"])[0].shape[0])
+
+
+def peek_num_clients(directory: str, step: Optional[int] = None
+                     ) -> Optional[int]:
+    """Client count of a checkpoint from the meta item alone (no state
+    read). None for checkpoints written before num_clients was recorded —
+    callers then fall back to :func:`load_checkpoint_raw`."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    meta = ocp.PyTreeCheckpointer().restore(
+        os.path.join(_ckpt_path(directory, step), "meta"))
+    nc = meta.get("num_clients")
+    return None if nc is None else int(np.asarray(nc))
 
 
 def load_checkpoint(directory: str, step: Optional[int] = None,
@@ -72,6 +129,10 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
             raise FileNotFoundError(f"no checkpoints under {directory}")
     path = _ckpt_path(directory, step)
     ckptr = ocp.PyTreeCheckpointer()
+    # The 'shared_start' marker is config, not data: never on disk (see
+    # _strip_marker), re-attached below from the live template.
+    had_marker = isinstance(state_like, dict) and "shared_start" in state_like
+    state_like = _strip_marker(state_like)
     template = to_numpy(state_like) if state_like is not None else None
     state = ckptr.restore(os.path.join(path, "state"), item=template)
     meta = ckptr.restore(os.path.join(path, "meta"))
@@ -96,6 +157,8 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
             lambda l: (jax.device_put(l, sharding)
                        if getattr(l, "ndim", 0) >= 1 else jax.device_put(l)),
             state)
+    if had_marker:
+        state["shared_start"] = ()
     history = {k: list(np.asarray(v))
                for k, v in meta["history"].items()}
     return state, history, int(np.asarray(meta["step"]))

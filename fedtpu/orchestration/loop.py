@@ -139,6 +139,10 @@ def build_experiment(cfg: ExperimentConfig,
         if cfg.fed.compress != "none":
             raise ValueError("compressed aggregation requires the 1-D "
                              "engine (model_parallel=1)")
+        if (cfg.fed.robust_aggregation != "none"
+                or cfg.fed.byzantine_clients > 0):
+            raise ValueError("robust aggregation / byzantine injection "
+                             "requires the 1-D engine (model_parallel=1)")
         # Only dims the tp specs actually place on the 'model' axis need to
         # divide: the col-sharded out-dims (even indices — row layers shard
         # the PREVIOUS layer's out-dim, already covered) plus, for convnets,
@@ -194,7 +198,10 @@ def build_experiment(cfg: ExperimentConfig,
             dp_clip_norm=cfg.fed.dp_clip_norm,
             dp_noise_multiplier=cfg.fed.dp_noise_multiplier,
             dp_seed=cfg.fed.dp_seed,
-            compress=cfg.fed.compress)
+            compress=cfg.fed.compress,
+            robust_aggregation=cfg.fed.robust_aggregation,
+            trim_ratio=cfg.fed.trim_ratio,
+            byzantine_clients=cfg.fed.byzantine_clients)
 
     batch = {
         "x": jax.device_put(packed.x, shard),
@@ -248,15 +255,60 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     start_round = 0
     restored_history = None
     if resume and cfg.run.checkpoint_dir:
-        from fedtpu.orchestration.checkpoint import latest_step, load_checkpoint
+        from fedtpu.orchestration.checkpoint import (
+            latest_step, load_checkpoint, load_checkpoint_raw,
+            peek_num_clients, saved_num_clients)
         if latest_step(cfg.run.checkpoint_dir) is not None:
-            # Per-leaf shardings come from the live state template, so the
-            # 2-D engine's tensor-parallel layout survives resume.
-            state, restored_history, start_round = load_checkpoint(
-                cfg.run.checkpoint_dir, state_like=state)
-            if verbose:
-                print(f"Resumed from checkpoint at round {start_round}.",
-                      flush=True)
+            # Cheap elastic detection from the meta item; only a count
+            # MISMATCH (or a pre-num_clients checkpoint) pays the raw read.
+            saved_c = peek_num_clients(cfg.run.checkpoint_dir)
+            if saved_c is None:
+                raw, raw_history, raw_round = load_checkpoint_raw(
+                    cfg.run.checkpoint_dir)
+                saved_c = saved_num_clients(raw)
+            elif saved_c != cfg.shard.num_clients:
+                raw, raw_history, raw_round = load_checkpoint_raw(
+                    cfg.run.checkpoint_dir)
+            if saved_c == cfg.shard.num_clients:
+                # Per-leaf shardings come from the live state template, so
+                # the 2-D engine's tensor-parallel layout survives resume.
+                state, restored_history, start_round = load_checkpoint(
+                    cfg.run.checkpoint_dir, state_like=state)
+                if verbose:
+                    print(f"Resumed from checkpoint at round {start_round}.",
+                          flush=True)
+            else:
+                # ELASTIC resume — the cluster grew or shrank (the reference
+                # cannot do this at all: client count is baked into `mpirun
+                # -np N`). Periodic checkpoints hold a post-averaging state,
+                # so every client slot is the same global model: collapse to
+                # the global (mean over slots == slot 0), re-broadcast over
+                # the NEW client count, and restore the client-count-
+                # independent server-optimizer state as-is. Per-client Adam
+                # moments cannot be re-shaped meaningfully across counts —
+                # they restart fresh (the same state a client joining a
+                # federation starts with).
+                g = jax.tree.map(lambda a: np.asarray(a).mean(axis=0),
+                                 raw["params"])
+                state["params"] = jax.tree.map(
+                    lambda gl, p: jax.device_put(
+                        np.broadcast_to(gl[None], p.shape).astype(p.dtype),
+                        p.sharding),
+                    g, state["params"])
+                if ("server_opt_state" in raw
+                        and "server_opt_state" in state):
+                    state["server_opt_state"] = jax.tree.map(
+                        lambda live, rawv: jax.device_put(
+                            np.asarray(rawv), live.sharding),
+                        state["server_opt_state"], raw["server_opt_state"])
+                state["round"] = jnp.asarray(raw_round, jnp.int32)
+                restored_history, start_round = raw_history, raw_round
+                if verbose:
+                    print(f"Elastic resume at round {raw_round}: "
+                          f"{saved_num_clients(raw)} -> "
+                          f"{cfg.shard.num_clients} clients (global model "
+                          "carried over, fresh client optimizer state).",
+                          flush=True)
 
     history = {k: [] for k in METRIC_NAMES}
     pooled_hist = {k: [] for k in METRIC_NAMES}

@@ -28,6 +28,7 @@ of hyperparameters_tuning.py:37. Optimizer state is deliberately NOT averaged
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable
 
 import jax
@@ -121,12 +122,20 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                    dp_clip_norm: float = 0.0,
                    dp_noise_multiplier: float = 0.0,
                    dp_seed: int = 0,
-                   compress: str = "none"):
+                   compress: str = "none",
+                   robust_aggregation: str = "none",
+                   trim_ratio: float = 0.1,
+                   byzantine_clients: int = 0):
     """Compile the full federated round. Returns
     ``round_step(state, batch) -> (state, metrics)`` where ``batch`` is a dict
     of client-sharded arrays ``x (C,N,...), y (C,N), mask (C,N)`` and
     ``metrics`` holds per-client, client-mean, and pooled views (the
     reference's two global-metric semantics, SURVEY.md §5).
+
+    ``round_step`` DONATES the input state (its buffers are consumed; params
+    and optimizer state update in place on device). Always rebind:
+    ``state, metrics = round_step(state, batch)``. To step one state down
+    two paths, step a ``fedtpu.utils.trees.clone`` of it.
 
     ``rounds_per_step=R`` runs R consecutive federated rounds inside ONE
     compiled program (``lax.scan`` over the round body): metric leaves gain a
@@ -164,6 +173,20 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     noised deltas). State must come from ``init_federated_state`` with the
     same ``server_opt`` so clients start at the server model and
     ``server_opt_state`` exists.
+
+    ``robust_aggregation``: 'median' (coordinate-wise median over clients)
+    or 'trimmed_mean' (drop the ``trim_ratio`` fraction of extreme values
+    per coordinate from each end, mean the rest) replace the weighted mean
+    — the standard Byzantine-robust rules: a minority of arbitrarily
+    corrupted client updates cannot move any coordinate beyond the honest
+    majority's range. Both are inherently UNWEIGHTED (order statistics have
+    no data-size weighting) and need every client's value per coordinate,
+    so they require full participation and the psum/plain-averaging path.
+    ``byzantine_clients = k`` is the matching FAULT INJECTION: the first k
+    clients' submitted updates are replaced in-graph with a 10x-amplified
+    sign-flipped update (a strong model-poisoning attack) while their local
+    metrics stay honest — the knob that lets tests and chaos runs prove the
+    robust rules hold and the plain mean breaks.
     """
 
     local_train = make_local_train_step(apply_fn, tx, local_steps=local_steps,
@@ -220,6 +243,28 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                          "aggregation='psum' with it")
     qmean = (make_quantized_weighted_mean(CLIENTS_AXIS)
              if compress == "int8" else None)
+    if robust_aggregation not in ("none", "median", "trimmed_mean"):
+        raise ValueError(f"unknown robust_aggregation "
+                         f"{robust_aggregation!r}; available: 'none', "
+                         "'median', 'trimmed_mean'")
+    robust = robust_aggregation != "none"
+    if robust and (delta_path or compress != "none"
+                   or aggregation != "psum"):
+        raise ValueError("robust_aggregation composes with the plain psum "
+                         "averaging path only (not server_opt/DP/compress/"
+                         "ring)")
+    if robust and sampling:
+        raise ValueError("robust_aggregation needs every client's value "
+                         "per coordinate — full participation required "
+                         "(participation_rate=1.0)")
+    if robust and weighting != "uniform":
+        raise ValueError("robust aggregation is unweighted (order "
+                         "statistics have no data-size weighting) — set "
+                         "weighting='uniform' to make that explicit")
+    if not 0 <= trim_ratio < 0.5:
+        raise ValueError(f"trim_ratio must be in [0, 0.5), got {trim_ratio}")
+    if byzantine_clients < 0:
+        raise ValueError("byzantine_clients must be >= 0")
 
     def round_body(params, opt_state, sstate, x, y, mask, rnd):
         # Shapes here are per-device blocks: leading axis Cb = C / n_devices.
@@ -263,6 +308,21 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
 
             conf = jax.vmap(local_eval)(params, x, y, mask)   # (Cb, K, K)
 
+            # Byzantine fault injection: the first k clients SUBMIT a
+            # 10x-amplified sign-flipped update (model poisoning) while
+            # their local training and metrics above stay honest — only
+            # what enters aggregation is corrupted, like a real attacker.
+            agg_params = params
+            if byzantine_clients > 0:
+                bad = gidx < byzantine_clients
+
+                def poison(t, s):
+                    shape = (cb,) + (1,) * (t.ndim - 1)
+                    return jnp.where(bad.reshape(shape),
+                                     s - 10.0 * (t - s), t)
+
+                agg_params = jax.tree.map(poison, params, start)
+
             if delta_path:
                 # Weighted mean of per-client UPDATES as a pseudo-gradient
                 # for the server optimizer (fedtpu.ops.server_opt). Eval
@@ -277,7 +337,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 # dp_fixed_denom note above); realized weight otherwise.
                 denom = (participation_rate * cb * n_devices
                          if dp_fixed_denom else jnp.maximum(total_w, 1.0))
-                delta = jax.tree.map(lambda t, s: t - s, params, start)
+                delta = jax.tree.map(lambda t, s: t - s, agg_params, start)
                 if dp_clip_norm > 0:
                     delta, _ = clip_by_global_norm(delta, dp_clip_norm)
 
@@ -326,7 +386,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 # round at the shared global (init_federated_state
                 # shared_start=True), like the delta path.
                 total_w = all_reduce(w.sum())             # clients-varying
-                delta = jax.tree.map(lambda t, s: t - s, params, start)
+                delta = jax.tree.map(lambda t, s: t - s, agg_params, start)
                 mean_delta = qmean(delta, w.astype(jnp.float32), total_w)
                 g = jax.tree.map(lambda s: s[0], start)   # slots identical
 
@@ -337,6 +397,33 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     return jnp.where(total_w > 0, out, p)
 
                 params = jax.tree.map(q_avg, g, mean_delta, params)
+            elif robust:
+                # Coordinate-wise order statistics need every client's
+                # value: gather the (corrupted-as-submitted) params across
+                # the mesh, then median / trimmed-mean per coordinate.
+                num_clients = cb * n_devices
+                k_trim = int(round(trim_ratio * num_clients))
+                if robust_aggregation == "trimmed_mean" and (
+                        2 * k_trim >= num_clients):
+                    raise ValueError(
+                        f"trim_ratio={trim_ratio} removes all "
+                        f"{num_clients} clients")
+
+                def ragg(p):
+                    pg = jax.lax.all_gather(p.astype(jnp.float32),
+                                            CLIENTS_AXIS)   # (D, Cb, ...)
+                    allc = pg.reshape((-1,) + pg.shape[2:])  # (C, ...)
+                    if robust_aggregation == "median":
+                        glob = jnp.median(allc, axis=0)
+                    else:
+                        srt = jnp.sort(allc, axis=0)
+                        if k_trim:
+                            srt = srt[k_trim:num_clients - k_trim]
+                        glob = srt.mean(axis=0)
+                    return jnp.broadcast_to(glob[None],
+                                            p.shape).astype(p.dtype)
+
+                params = jax.tree.map(ragg, agg_params)
             else:
                 total_w = all_reduce(w.sum())             # clients-varying
 
@@ -352,7 +439,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     # Zero participants (under sampling): skip averaging.
                     return jnp.where(total_w > 0, out, p)
 
-                params = jax.tree.map(avg, params)
+                params = jax.tree.map(avg, agg_params)
             pooled_conf = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)
             return (params, opt_state, sstate, r + 1), (loss, conf,
                                                         pooled_conf)
@@ -373,7 +460,11 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
         out_specs=(spec_c, spec_c, P(), spec_rc, spec_rc, P()),
     )
 
-    @jax.jit
+    # Donate the state: every caller rebinds `state = round_step(state, ...)`,
+    # so XLA can update params/opt-state in place instead of allocating a
+    # fresh copy of every buffer each chunk (the batch is NOT donated — it is
+    # reused every call). CPU ignores donation with a warning; TPU honors it.
+    @partial(jax.jit, donate_argnums=(0,))
     def round_step(state, batch):
         if delta_path and "server_opt_state" not in state:
             raise ValueError(

@@ -18,3 +18,16 @@ def tree_bytes(tree) -> int:
 def to_numpy(tree):
     """Device -> host copy of a whole pytree."""
     return jax.tree.map(lambda l: np.asarray(jax.device_get(l)), tree)
+
+
+def clone(tree):
+    """Fresh device buffers with the same values and shardings.
+
+    The compiled round step DONATES its input state
+    (fedtpu.parallel.round.build_round_fn): after ``new = round_step(state,
+    batch)`` the old ``state``'s buffers are gone. Callers that need the
+    pre-step state afterwards (A/B comparisons, snapshots) should step a
+    ``clone(state)`` instead.
+    """
+    return jax.tree.map(
+        lambda l: l.copy() if isinstance(l, jax.Array) else l, tree)

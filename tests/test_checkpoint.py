@@ -51,3 +51,74 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
         np.asarray(cont_restored["params"]["layers"][0]["w"]),
         np.asarray(cont_live["params"]["layers"][0]["w"]), rtol=0, atol=0)
     assert int(cont_restored["round"]) == 4
+
+
+def test_elastic_resume_changes_client_count(tmp_path):
+    """Resume an 8-client run as a 4-client run AND as a 16-client run —
+    each leg from the same 8-client round-4 checkpoint, each actually
+    training rounds 5-6 under the new count. The reference cannot do this:
+    its client count is baked into the `mpirun -np N` launch."""
+    import shutil
+    from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                               RunConfig)
+    from fedtpu.orchestration.loop import run_experiment
+
+    ckdir = str(tmp_path / "elastic")
+    base = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=8, shuffle=False),
+        model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
+        optim=OptimConfig(),
+        fed=FedConfig(rounds=4, server_opt="fedadam", server_lr=0.02),
+        run=RunConfig(checkpoint_dir=ckdir, checkpoint_every=2),
+    )
+    first = run_experiment(base, verbose=False)
+    assert first.rounds_run == 4
+
+    for new_clients in (4, 16):
+        # Fresh dir seeded with the 8-client checkpoint, so each leg
+        # resumes 8 -> new_clients (not from the previous leg's output).
+        leg_dir = str(tmp_path / f"leg{new_clients}")
+        shutil.copytree(ckdir, leg_dir)
+        cfg = base.replace(
+            shard=ShardConfig(num_clients=new_clients, shuffle=False),
+            fed=FedConfig(rounds=6, server_opt="fedadam", server_lr=0.02),
+            run=RunConfig(checkpoint_dir=leg_dir, checkpoint_every=0),
+        )
+        result = run_experiment(cfg, verbose=False, resume=True)
+        # Continued from round 4, trained rounds 5-6 under the new count.
+        assert result.rounds_run == 6
+        assert len(result.global_metrics["accuracy"]) == 6
+        # Rounds 5-6 really ran: their metrics were appended (finite) and
+        # timing entries exist for the post-resume chunks.
+        assert all(np.isfinite(v) for v in result.global_metrics["accuracy"])
+        assert len(result.sec_per_round) == 2
+
+
+def test_elastic_resume_carries_global_model(tmp_path):
+    """The resumed (different-count) run restores EXACTLY the checkpointed
+    global model: resume with rounds == saved round trains nothing, so its
+    final_params are purely the elastic collapse/broadcast output."""
+    from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                               RunConfig)
+    from fedtpu.orchestration.loop import run_experiment
+
+    ckdir = str(tmp_path / "elastic2")
+    base = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=8, shuffle=False),
+        model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
+        fed=FedConfig(rounds=2),
+        run=RunConfig(checkpoint_dir=ckdir, checkpoint_every=2),
+    )
+    first = run_experiment(base, verbose=False)
+
+    cfg4 = base.replace(shard=ShardConfig(num_clients=4, shuffle=False))
+    resumed = run_experiment(cfg4, verbose=False, resume=True)
+    assert resumed.rounds_run == 2          # nothing new trained
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b), atol=1e-6),
+        first.final_params, resumed.final_params)

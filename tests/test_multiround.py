@@ -12,6 +12,7 @@ from fedtpu.models import build_model
 from fedtpu.ops import build_optimizer
 from fedtpu.orchestration.loop import run_experiment
 from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.utils.trees import clone
 from fedtpu.parallel.round import build_round_fn, init_federated_state
 
 
@@ -26,7 +27,7 @@ def test_scanned_rounds_match_single_round_trajectory():
              {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
 
     state_a = init_federated_state(jax.random.key(3), mesh, 8, init_fn, tx)
-    state_b = jax.tree.map(lambda v: v, state_a)
+    state_b = clone(state_a)
 
     single = build_round_fn(mesh, apply_fn, tx, 2, rounds_per_step=1)
     scanned = build_round_fn(mesh, apply_fn, tx, 2, rounds_per_step=4)

@@ -10,6 +10,7 @@ from fedtpu.data.tabular import synthetic_income_like
 from fedtpu.models import build_model
 from fedtpu.ops import build_optimizer
 from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.utils.trees import clone
 from fedtpu.parallel.round import build_round_fn, init_federated_state
 
 
@@ -30,7 +31,7 @@ def _setup(lr=0.004, **round_kw):
 
 def test_full_participation_is_default_behavior():
     state, batch, step_default, _ = _setup()
-    state2 = jax.tree.map(lambda v: v, state)
+    state2 = clone(state)
     _, batch2, step_rate1, _ = _setup(participation_rate=1.0)
     a, _ = step_default(state, batch)
     b, _ = step_rate1(state2, batch)
@@ -41,7 +42,7 @@ def test_full_participation_is_default_behavior():
 
 def test_sampling_is_deterministic_in_seed():
     state, batch, step, _ = _setup(participation_rate=0.5, participation_seed=7)
-    state2 = jax.tree.map(lambda v: v, state)
+    state2 = clone(state)
     a, _ = step(state, batch)
     b, _ = step(state2, batch)
     np.testing.assert_allclose(np.asarray(a["params"]["layers"][0]["w"]),

@@ -89,7 +89,9 @@ def test_round_with_ring_aggregation_matches_psum(aggregation,
                                rounds_per_step=rounds_per_step)
     step_ring = build_round_fn(mesh, apply_fn, tx, 2, aggregation=aggregation,
                                rounds_per_step=rounds_per_step)
-    s1, m1 = step_psum(state, batch)
+    from fedtpu.utils.trees import clone
+    # round_step donates its input state; clone to step the same start twice.
+    s1, m1 = step_psum(clone(state), batch)
     s2, m2 = step_ring(state, batch)
     # Ring sums in neighbor order — same value up to float reassociation.
     jax.tree.map(

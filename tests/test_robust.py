@@ -1,0 +1,158 @@
+"""Byzantine-robust aggregation (median / trimmed mean) + the matching
+model-poisoning fault injection. The attack/defense pair the reference has
+no analogue of: its only aggregation is the mean, which a single malicious
+rank can move arbitrarily far."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.parallel.round import build_round_fn, init_federated_state
+from fedtpu.utils.trees import clone
+
+
+def _setup(num_clients=8, rows=200, lr=0.004, **round_kw):
+    x, y = synthetic_income_like(rows, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=num_clients,
+                                            shuffle=False))
+    mesh = make_mesh(num_clients=num_clients)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig(learning_rate=lr))
+    state = init_federated_state(jax.random.key(1), mesh, num_clients,
+                                 init_fn, tx, same_init=True)
+    shard = client_sharding(mesh)
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    round_step = build_round_fn(mesh, apply_fn, tx, 2, **round_kw)
+    return state, batch, round_step
+
+
+def _leaf0(state):
+    return np.asarray(jax.tree.leaves(state["params"])[0])
+
+
+def test_median_matches_numpy_oracle():
+    # lr=0 freezes training, but same_init makes all slots equal — use
+    # different inits so the median has something to select.
+    state, batch, step = _setup(lr=0.0, robust_aggregation="median",
+                            weighting="uniform")
+    mesh = make_mesh(num_clients=8)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig(learning_rate=0.0))
+    state = init_federated_state(jax.random.key(3), mesh, 8, init_fn, tx,
+                                 same_init=False)
+    before = _leaf0(state)                       # (8, in, out), all distinct
+    new_state, _ = step(state, batch)
+    after = _leaf0(new_state)
+    expected = np.median(before, axis=0)
+    for c in range(8):
+        np.testing.assert_allclose(after[c], expected, atol=1e-6)
+
+
+def test_trimmed_mean_matches_numpy_oracle():
+    state0, batch, step = _setup(lr=0.0, robust_aggregation="trimmed_mean",
+                                 trim_ratio=0.25, weighting="uniform")   # trims 2 of 8 per end
+    mesh = make_mesh(num_clients=8)
+    init_fn, _ = build_model(ModelConfig(input_dim=6, hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig(learning_rate=0.0))
+    state = init_federated_state(jax.random.key(3), mesh, 8, init_fn, tx,
+                                 same_init=False)
+    before = _leaf0(state)
+    new_state, _ = step(state, batch)
+    after = _leaf0(new_state)
+    srt = np.sort(before, axis=0)
+    expected = srt[2:6].mean(axis=0)
+    np.testing.assert_allclose(after[0], expected, atol=1e-6)
+
+
+def test_median_resists_byzantine_minority_mean_does_not():
+    # 2 of 8 clients submit 10x sign-flipped updates. The median's global
+    # must stay within the honest range; the mean's must leave it.
+    kw = dict(byzantine_clients=2, weighting="uniform")
+    m_state, batch, m_step = _setup(robust_aggregation="median", **kw)
+    a_state, _, a_step = _setup(robust_aggregation="none", **kw)
+    h_state, _, h_step = _setup(robust_aggregation="none",
+                                weighting="uniform")  # no attack: honest ref
+
+    start = _leaf0(m_state)[0]
+    m_state, _ = m_step(m_state, batch)
+    a_state, _ = a_step(a_state, batch)
+    h_state, _ = h_step(h_state, batch)
+
+    honest_move = np.abs(_leaf0(h_state)[0] - start).max()
+    median_move = np.abs(_leaf0(m_state)[0] - start).max()
+    mean_move = np.abs(_leaf0(a_state)[0] - start).max()
+    # Poisoned mean: 2/8 clients at -10x shift the mean by ~(1-2*11/8)=~-1.75x
+    # the honest step; the median ignores the 2 outliers entirely.
+    assert mean_move > 1.5 * honest_move
+    assert median_move <= 1.5 * honest_move
+
+
+def test_byzantine_injection_converges_under_median():
+    state, batch, step = _setup(robust_aggregation="median",
+                                byzantine_clients=2, weighting="uniform")
+    for _ in range(20):
+        state, metrics = step(state, batch)
+    acc = float(metrics["client_mean"]["accuracy"])
+    assert np.isfinite(acc) and acc > 0.5
+
+
+def test_byzantine_composes_with_dp_clipping():
+    # Clipping bounds the poisoned update's norm: with clip c, lr 1, the
+    # global step is at most c even with every client malicious.
+    from fedtpu.ops.server_opt import identity_server_optimizer
+    clip = 1e-3
+    mesh = make_mesh(num_clients=8)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig())
+    server = identity_server_optimizer()
+    state = init_federated_state(jax.random.key(1), mesh, 8, init_fn, tx,
+                                 same_init=True, server_opt=server)
+    x, y = synthetic_income_like(200, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=8, shuffle=False))
+    shard = client_sharding(mesh)
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    step = build_round_fn(mesh, apply_fn, tx, 2, server_opt=server,
+                          dp_clip_norm=clip, byzantine_clients=8,
+                          weighting="uniform")
+    g0 = jax.tree.map(lambda p: np.asarray(p)[0], state["params"])
+    state, _ = step(state, batch)
+    g1 = jax.tree.map(lambda p: np.asarray(p)[0], state["params"])
+    moved = np.sqrt(sum(np.sum((a - b) ** 2) for a, b in
+                        zip(jax.tree.leaves(g1), jax.tree.leaves(g0))))
+    assert moved <= clip * (1 + 1e-5)
+
+
+def test_robust_rejects_bad_combos():
+    with pytest.raises(ValueError, match="unknown robust_aggregation"):
+        _setup(robust_aggregation="krum")
+    with pytest.raises(ValueError, match="full participation"):
+        _setup(robust_aggregation="median", weighting="uniform",
+               participation_rate=0.5)
+    with pytest.raises(ValueError, match="unweighted"):
+        _setup(robust_aggregation="median")   # default data_size weighting
+    with pytest.raises(ValueError, match="plain psum"):
+        _setup(robust_aggregation="median", weighting="uniform",
+               aggregation="ring")
+    with pytest.raises(ValueError, match="plain psum"):
+        _setup(robust_aggregation="median", weighting="uniform",
+               dp_clip_norm=1.0)
+    with pytest.raises(ValueError, match="trim_ratio"):
+        _setup(robust_aggregation="trimmed_mean", weighting="uniform",
+               trim_ratio=0.5)
+    with pytest.raises(ValueError, match="removes all"):
+        # 0.49 of 8 clients rounds to 4 per end -> nothing left.
+        state, batch, step = _setup(robust_aggregation="trimmed_mean",
+                                    weighting="uniform", trim_ratio=0.49)
+        step(state, batch)
