@@ -23,8 +23,12 @@ Usage (same script on every host, e.g. a v4-32's 4 workers):
     batch = multihost.distribute_client_batch(packed, mesh)
     ...                                         # identical from here on
 
-Verified single-process (initialize() is a no-op there); the multi-process
-path follows the standard jax.distributed contract.
+Verified single-process (initialize() is a no-op there) AND multi-process:
+tests/test_multihost_e2e.py launches two OS processes with four virtual CPU
+devices each, wires them into one jax.distributed runtime, and runs the full
+round program over the global 8-client mesh — the FedAvg collectives cross
+the process boundary over TCP/gloo (the CPU stand-in for DCN) and both
+processes hold the identical global model, matching the single-process run.
 """
 
 from __future__ import annotations

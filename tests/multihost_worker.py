@@ -1,0 +1,89 @@
+"""Worker for the multi-process (DCN-path) integration test.
+
+Launched by tests/test_multihost_e2e.py as 2 OS processes, each exposing 4
+virtual CPU devices; jax.distributed wires them into ONE runtime with 8
+global devices, and the standard fedtpu round program runs over the global
+('clients',) mesh — collectives cross the process boundary over TCP/gloo,
+the CPU stand-in for DCN. This is the executable version of the
+fedtpu.parallel.multihost contract (the reference's `mpirun --hostfile`
+analogue, SURVEY.md §2c).
+
+Writes, per process: the post-round global model (every client slot holds
+it) and the client-mean accuracy, for the parent test to cross-check.
+"""
+
+import os
+import sys
+
+# Shared experiment constants — imported by tests/test_multihost_e2e.py for
+# its single-process cross-check, so the two programs cannot drift.
+ROWS, FEATURES, CLASSES = 200, 6, 2
+NUM_CLIENTS = 8
+HIDDEN = (8,)
+SEED = 1
+ROUNDS_PER_STEP = 2
+OUTER_STEPS = 2
+
+
+def main():
+    pid, nprocs, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                 sys.argv[3], sys.argv[4])
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from fedtpu.parallel import multihost
+
+    # Before ANY other jax usage (the jax.distributed contract).
+    multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs
+    assert len(jax.devices()) == 4 * nprocs
+
+    import numpy as np
+    from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+    from fedtpu.data.sharding import pack_clients
+    from fedtpu.data.tabular import synthetic_income_like
+    from fedtpu.models import build_model
+    from fedtpu.ops import build_optimizer
+    from fedtpu.parallel.mesh import make_mesh
+    from fedtpu.parallel.round import build_round_fn, init_federated_state
+
+    # Deterministic synthetic data — identical on every process.
+    x, y = synthetic_income_like(ROWS, FEATURES, CLASSES)
+    packed = pack_clients(x, y, ShardConfig(num_clients=NUM_CLIENTS,
+                                            shuffle=False))
+
+    mesh = make_mesh(num_clients=NUM_CLIENTS)    # global 8-device mesh
+    batch = multihost.distribute_client_batch(packed, mesh)
+
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=FEATURES,
+                                                hidden_sizes=HIDDEN))
+    tx = build_optimizer(OptimConfig())
+    state = init_federated_state(jax.random.key(SEED), mesh, NUM_CLIENTS,
+                                 init_fn, tx, same_init=True)
+    step = build_round_fn(mesh, apply_fn, tx, CLASSES,
+                          rounds_per_step=ROUNDS_PER_STEP)
+
+    for _ in range(OUTER_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state["params"])
+
+    # Every client slot holds the averaged global model; read this
+    # process's first addressable slot.
+    leaf = jax.tree.leaves(state["params"])[0]
+    local0 = np.asarray(leaf.addressable_shards[0].data)[0]
+    acc = float(np.asarray(metrics["client_mean"]["accuracy"])[-1])
+
+    np.save(os.path.join(outdir, f"params_{pid}.npy"), local0)
+    with open(os.path.join(outdir, f"acc_{pid}.txt"), "w") as f:
+        f.write(repr(acc))
+    print(f"worker {pid}: ok acc={acc:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
