@@ -94,12 +94,15 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                         "psum traffic at D devices; for few-host DCN-bound "
                         "aggregation)")
     p.add_argument("--robust-aggregation",
-                   choices=["none", "median", "trimmed_mean"], default=None,
+                   choices=["none", "median", "trimmed_mean", "krum"],
+                   default=None,
                    help="Byzantine-robust aggregation rule (requires "
                         "--weighting uniform and full participation)")
     p.add_argument("--trim-ratio", type=_nonnegative_float, default=None,
                    help="fraction trimmed from each end per coordinate "
                         "(trimmed_mean)")
+    p.add_argument("--krum-f", type=int, default=None,
+                   help="krum's assumed number of malicious clients")
     p.add_argument("--byzantine-clients", type=int, default=None,
                    help="fault injection: first k clients submit 10x "
                         "sign-flipped updates")
@@ -179,6 +182,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
                                   robust_aggregation=args.robust_aggregation)
     if args.trim_ratio is not None:
         fed = dataclasses.replace(fed, trim_ratio=args.trim_ratio)
+    if args.krum_f is not None:
+        fed = dataclasses.replace(fed, krum_f=args.krum_f)
     if args.byzantine_clients is not None:
         fed = dataclasses.replace(fed,
                                   byzantine_clients=args.byzantine_clients)

@@ -167,13 +167,15 @@ class FedConfig:
     dp_seed: int = 0
     # Byzantine-robust aggregation: 'none' (weighted mean — the reference's
     # rule) | 'median' (coordinate-wise) | 'trimmed_mean' (drop trim_ratio
-    # from each end per coordinate). Order statistics are unweighted, so
-    # weighting='uniform' is required (making the semantics explicit); full
-    # participation + plain psum path only. byzantine_clients injects k
-    # model-poisoning clients (10x sign-flipped updates) as the matching
-    # fault injection.
+    # from each end per coordinate) | 'krum' (select the single client
+    # update closest to its C - krum_f - 2 nearest peers). Robust rules are
+    # unweighted, so weighting='uniform' is required (making the semantics
+    # explicit); full participation + plain psum path only.
+    # byzantine_clients injects k model-poisoning clients (10x sign-flipped
+    # updates) as the matching fault injection.
     robust_aggregation: str = "none"
     trim_ratio: float = 0.1
+    krum_f: int = 0                      # krum's assumed malicious count
     byzantine_clients: int = 0
     # Quantized update exchange (fedtpu.parallel.compress): 'none' | 'int8'
     # — per-device weighted partial sums quantized to int8 and all-gathered.

@@ -201,6 +201,7 @@ def build_experiment(cfg: ExperimentConfig,
             compress=cfg.fed.compress,
             robust_aggregation=cfg.fed.robust_aggregation,
             trim_ratio=cfg.fed.trim_ratio,
+            krum_f=cfg.fed.krum_f,
             byzantine_clients=cfg.fed.byzantine_clients)
 
     batch = {

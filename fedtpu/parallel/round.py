@@ -125,6 +125,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                    compress: str = "none",
                    robust_aggregation: str = "none",
                    trim_ratio: float = 0.1,
+                   krum_f: int = 0,
                    byzantine_clients: int = 0):
     """Compile the full federated round. Returns
     ``round_step(state, batch) -> (state, metrics)`` where ``batch`` is a dict
@@ -174,14 +175,17 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     same ``server_opt`` so clients start at the server model and
     ``server_opt_state`` exists.
 
-    ``robust_aggregation``: 'median' (coordinate-wise median over clients)
-    or 'trimmed_mean' (drop the ``trim_ratio`` fraction of extreme values
-    per coordinate from each end, mean the rest) replace the weighted mean
-    — the standard Byzantine-robust rules: a minority of arbitrarily
-    corrupted client updates cannot move any coordinate beyond the honest
-    majority's range. Both are inherently UNWEIGHTED (order statistics have
-    no data-size weighting) and need every client's value per coordinate,
-    so they require full participation and the psum/plain-averaging path.
+    ``robust_aggregation``: 'median' (coordinate-wise median over clients),
+    'trimmed_mean' (drop the ``trim_ratio`` fraction of extreme values
+    per coordinate from each end, mean the rest), or 'krum' (Blanchard et
+    al. 2017: pick the ONE client whose update has the smallest summed
+    squared distance to its ``C - krum_f - 2`` nearest peers, ``krum_f`` =
+    assumed malicious count) replace the weighted mean — the standard
+    Byzantine-robust rules: a minority of arbitrarily corrupted client
+    updates cannot move any coordinate beyond the honest majority's range
+    (median/trimmed-mean) or be selected at all (krum). All are inherently
+    UNWEIGHTED and need every client's value, so they require full
+    participation and the psum/plain-averaging path.
     ``byzantine_clients = k`` is the matching FAULT INJECTION: the first k
     clients' submitted updates are replaced in-graph with a 10x-amplified
     sign-flipped update (a strong model-poisoning attack) while their local
@@ -243,10 +247,10 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                          "aggregation='psum' with it")
     qmean = (make_quantized_weighted_mean(CLIENTS_AXIS)
              if compress == "int8" else None)
-    if robust_aggregation not in ("none", "median", "trimmed_mean"):
+    if robust_aggregation not in ("none", "median", "trimmed_mean", "krum"):
         raise ValueError(f"unknown robust_aggregation "
                          f"{robust_aggregation!r}; available: 'none', "
-                         "'median', 'trimmed_mean'")
+                         "'median', 'trimmed_mean', 'krum'")
     robust = robust_aggregation != "none"
     if robust and (delta_path or compress != "none"
                    or aggregation != "psum"):
@@ -263,6 +267,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                          "weighting='uniform' to make that explicit")
     if not 0 <= trim_ratio < 0.5:
         raise ValueError(f"trim_ratio must be in [0, 0.5), got {trim_ratio}")
+    if krum_f < 0:
+        raise ValueError("krum_f must be >= 0")
     if byzantine_clients < 0:
         raise ValueError("byzantine_clients must be >= 0")
 
@@ -398,9 +404,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
 
                 params = jax.tree.map(q_avg, g, mean_delta, params)
             elif robust:
-                # Coordinate-wise order statistics need every client's
-                # value: gather the (corrupted-as-submitted) params across
-                # the mesh, then median / trimmed-mean per coordinate.
+                # Robust rules need every client's submitted value: gather
+                # the (corrupted-as-submitted) params across the mesh.
                 num_clients = cb * n_devices
                 k_trim = int(round(trim_ratio * num_clients))
                 if robust_aggregation == "trimmed_mean" and (
@@ -408,22 +413,70 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     raise ValueError(
                         f"trim_ratio={trim_ratio} removes all "
                         f"{num_clients} clients")
+                if robust_aggregation == "krum" and (
+                        num_clients < 2 * krum_f + 3):
+                    # Blanchard et al.'s Byzantine-resilience precondition
+                    # n > 2f + 2 — below it, f colluding clients can win
+                    # the score and the guarantee is void.
+                    raise ValueError(
+                        f"krum needs >= 2 * krum_f + 3 clients "
+                        f"(got C={num_clients}, krum_f={krum_f})")
 
-                def ragg(p):
+                def gather_clients(p):
                     pg = jax.lax.all_gather(p.astype(jnp.float32),
                                             CLIENTS_AXIS)   # (D, Cb, ...)
-                    allc = pg.reshape((-1,) + pg.shape[2:])  # (C, ...)
-                    if robust_aggregation == "median":
-                        glob = jnp.median(allc, axis=0)
-                    else:
-                        srt = jnp.sort(allc, axis=0)
-                        if k_trim:
-                            srt = srt[k_trim:num_clients - k_trim]
-                        glob = srt.mean(axis=0)
-                    return jnp.broadcast_to(glob[None],
-                                            p.shape).astype(p.dtype)
+                    return pg.reshape((-1,) + pg.shape[2:])  # (C, ...)
 
-                params = jax.tree.map(ragg, agg_params)
+                if robust_aggregation == "krum":
+                    # Blanchard et al. 2017: score each client by the sum
+                    # of squared distances to its C - f - 2 nearest peers;
+                    # the winner's whole update becomes the global. MXU
+                    # form: pairwise distances via the gram matrix of the
+                    # flattened updates.
+                    gathered = jax.tree.map(gather_clients, agg_params)
+                    flat = jnp.concatenate(
+                        [g.reshape(num_clients, -1)
+                         for g in jax.tree.leaves(gathered)], axis=1)
+                    # Pairwise distances are invariant under any common
+                    # shift: center on the client mean BEFORE the gram
+                    # matrix, so the shared model magnitude (>> per-client
+                    # differences late in training) cancels exactly instead
+                    # of catastrophically in f32 — otherwise rounding noise
+                    # ~eps*||params||^2 can outweigh the honest-vs-poisoned
+                    # distance gap and noise-rank the scores.
+                    flat = flat - flat.mean(axis=0, keepdims=True)
+                    gram = flat @ flat.T                     # (C, C)
+                    sq = jnp.diag(gram)
+                    d2 = sq[:, None] + sq[None, :] - 2.0 * gram
+                    d2 = jnp.where(jnp.eye(num_clients, dtype=bool),
+                                   jnp.inf, d2)              # exclude self
+                    k_near = num_clients - krum_f - 2
+                    scores = jnp.sort(d2, axis=1)[:, :k_near].sum(axis=1)
+                    winner = jnp.argmin(scores)
+
+                    def select_winner(g, p):
+                        glob = jax.lax.dynamic_index_in_dim(
+                            g, winner, keepdims=False)
+                        return jnp.broadcast_to(glob[None],
+                                                p.shape).astype(p.dtype)
+
+                    params = jax.tree.map(select_winner, gathered,
+                                          agg_params)
+                else:
+
+                    def ragg(p):
+                        allc = gather_clients(p)
+                        if robust_aggregation == "median":
+                            glob = jnp.median(allc, axis=0)
+                        else:
+                            srt = jnp.sort(allc, axis=0)
+                            if k_trim:
+                                srt = srt[k_trim:num_clients - k_trim]
+                            glob = srt.mean(axis=0)
+                        return jnp.broadcast_to(glob[None],
+                                                p.shape).astype(p.dtype)
+
+                    params = jax.tree.map(ragg, agg_params)
             else:
                 total_w = all_reduce(w.sum())             # clients-varying
 

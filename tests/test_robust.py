@@ -136,7 +136,7 @@ def test_byzantine_composes_with_dp_clipping():
 
 def test_robust_rejects_bad_combos():
     with pytest.raises(ValueError, match="unknown robust_aggregation"):
-        _setup(robust_aggregation="krum")
+        _setup(robust_aggregation="geometric_median")
     with pytest.raises(ValueError, match="full participation"):
         _setup(robust_aggregation="median", weighting="uniform",
                participation_rate=0.5)
@@ -156,3 +156,82 @@ def test_robust_rejects_bad_combos():
         state, batch, step = _setup(robust_aggregation="trimmed_mean",
                                     weighting="uniform", trim_ratio=0.49)
         step(state, batch)
+
+
+def test_krum_matches_numpy_oracle():
+    # lr=0, distinct inits: krum must pick exactly the client numpy says.
+    state, batch, step = _setup(lr=0.0, robust_aggregation="krum",
+                                krum_f=2, weighting="uniform")
+    mesh = make_mesh(num_clients=8)
+    init_fn, _ = build_model(ModelConfig(input_dim=6, hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig(learning_rate=0.0))
+    state = init_federated_state(jax.random.key(3), mesh, 8, init_fn, tx,
+                                 same_init=False)
+    flat = np.concatenate(
+        [np.asarray(l).reshape(8, -1)
+         for l in jax.tree.leaves(state["params"])], axis=1)
+    d2 = ((flat[:, None, :] - flat[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    scores = np.sort(d2, axis=1)[:, :8 - 2 - 2].sum(axis=1)
+    winner = int(np.argmin(scores))
+    expected = _leaf0(state)[winner]
+
+    new_state, _ = step(state, batch)
+    after = _leaf0(new_state)
+    for c in range(8):
+        np.testing.assert_allclose(after[c], expected, atol=1e-6)
+
+
+def test_krum_resists_byzantine_minority():
+    # 2 of 8 poisoned, krum_f=2: the winner must be an honest client, so
+    # the global stays within the honest movement range.
+    kw = dict(byzantine_clients=2, weighting="uniform")
+    k_state, batch, k_step = _setup(robust_aggregation="krum", krum_f=2,
+                                    **kw)
+    h_state, _, h_step = _setup(robust_aggregation="none",
+                                weighting="uniform")
+    start = _leaf0(k_state)[0]
+    k_state, _ = k_step(k_state, batch)
+    h_state, _ = h_step(h_state, batch)
+    honest_move = np.abs(_leaf0(h_state)[0] - start).max()
+    krum_move = np.abs(_leaf0(k_state)[0] - start).max()
+    # A poisoned winner would move ~10x the honest step.
+    assert krum_move <= 3 * honest_move
+
+
+def test_krum_rejects_byzantine_majority_config():
+    # Blanchard precondition n >= 2f + 3: krum_f=3 with 8 clients is
+    # well-defined arithmetic but the resilience guarantee is void.
+    state, batch, step = _setup(robust_aggregation="krum", krum_f=3,
+                                weighting="uniform")
+    with pytest.raises(ValueError, match="2 \\* krum_f \\+ 3"):
+        step(state, batch)
+
+
+def test_krum_centering_survives_large_common_offset():
+    # Distances are shift-invariant; the implementation centers on the
+    # client mean before the gram matrix so a large shared model magnitude
+    # cannot noise-rank the f32 scores. Same oracle winner with a huge
+    # common offset added to every client.
+    state, batch, step = _setup(lr=0.0, robust_aggregation="krum",
+                                krum_f=2, weighting="uniform")
+    mesh = make_mesh(num_clients=8)
+    init_fn, _ = build_model(ModelConfig(input_dim=6, hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig(learning_rate=0.0))
+    state = init_federated_state(jax.random.key(3), mesh, 8, init_fn, tx,
+                                 same_init=False)
+    # Add a large identical offset to every client's params (f64 oracle
+    # first, from the un-shifted values).
+    flat = np.concatenate(
+        [np.asarray(l).reshape(8, -1)
+         for l in jax.tree.leaves(state["params"])], axis=1).astype(np.float64)
+    d2 = ((flat[:, None, :] - flat[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    scores = np.sort(d2, axis=1)[:, :8 - 2 - 2].sum(axis=1)
+    winner = int(np.argmin(scores))
+    expected = _leaf0(state)[winner] + 1e4
+
+    state["params"] = jax.tree.map(lambda p: p + 1e4, state["params"])
+    new_state, _ = step(state, batch)
+    after = _leaf0(new_state)
+    np.testing.assert_allclose(after[0], expected, rtol=1e-6)
