@@ -151,7 +151,8 @@ class FedConfig:
     # Server-side optimizer over the weighted mean of client DELTAS (FedOpt
     # family, fedtpu.ops.server_opt): 'none' (parameter averaging — the
     # reference's rule) | 'fedavgm' | 'fedadagrad' | 'fedyogi' | 'fedadam'.
-    # Requires aggregation='psum' and the 1-D engine.
+    # Requires aggregation='psum'; works on BOTH engines (1-D shard_map and
+    # the 2-D tensor-parallel GSPMD engine).
     server_opt: str = "none"
     server_lr: float = 1.0               # 1.0 + fedavgm momentum 0 == FedAvg
     server_momentum: float = 0.9         # fedavgm only

@@ -119,6 +119,26 @@ def build_experiment(cfg: ExperimentConfig,
     tx = build_optimizer(cfg.optim)
     packed = pack_clients(ds.x_train, ds.y_train, cfg.shard)
 
+    # Fail fast on a DP config the round builders would reject later —
+    # after data loading and state init (both engines share this check).
+    if cfg.fed.dp_noise_multiplier > 0 and cfg.fed.dp_clip_norm <= 0:
+        raise ValueError("dp_noise_multiplier requires dp_clip_norm > 0 "
+                         "(noise std is noise_multiplier * clip / weight)")
+
+    # Server optimizer / DP delta path: shared by both engines.
+    server = None
+    if cfg.fed.server_opt != "none":
+        from fedtpu.ops.server_opt import make_server_optimizer
+        server = make_server_optimizer(
+            cfg.fed.server_opt, learning_rate=cfg.fed.server_lr,
+            momentum=cfg.fed.server_momentum, b1=cfg.fed.server_b1,
+            b2=cfg.fed.server_b2, tau=cfg.fed.server_tau)
+    elif cfg.fed.dp_clip_norm > 0:
+        # DP with plain averaging still runs the delta path and needs
+        # the (empty-momentum) server state initialized.
+        from fedtpu.ops.server_opt import identity_server_optimizer
+        server = identity_server_optimizer()
+
     if cfg.run.model_parallel > 1:
         # 2-D ('clients','model') GSPMD engine (fedtpu.parallel.tp).
         from fedtpu.parallel import tp
@@ -132,10 +152,6 @@ def build_experiment(cfg: ExperimentConfig,
             raise ValueError("explicit ring aggregation requires the 1-D "
                              "engine (model_parallel=1); the 2-D engine's "
                              "collectives are GSPMD-chosen")
-        if (cfg.fed.server_opt != "none" or cfg.fed.dp_clip_norm > 0
-                or cfg.fed.dp_noise_multiplier > 0):
-            raise ValueError("server_opt / DP aggregation requires the 1-D "
-                             "engine (model_parallel=1)")
         if cfg.fed.compress != "none":
             raise ValueError("compressed aggregation requires the 1-D "
                              "engine (model_parallel=1)")
@@ -162,26 +178,18 @@ def build_experiment(cfg: ExperimentConfig,
         shard = tp.batch_sharding_2d(mesh)
         state_fn = lambda: tp.init_federated_state_2d(
             jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
-            init_fn, tx, same_init=cfg.fed.same_init)
+            init_fn, tx, same_init=cfg.fed.same_init, server_opt=server)
         step_fn = lambda r: tp.build_round_fn_2d(
             mesh, apply_fn, tx, ds.num_classes, weighting=cfg.fed.weighting,
             rounds_per_step=r, local_steps=cfg.fed.local_steps,
-            prox_mu=cfg.fed.prox_mu)
+            prox_mu=cfg.fed.prox_mu,
+            server_opt=server,
+            dp_clip_norm=cfg.fed.dp_clip_norm,
+            dp_noise_multiplier=cfg.fed.dp_noise_multiplier,
+            dp_seed=cfg.fed.dp_seed)
     else:
         mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
         shard = client_sharding(mesh)
-        server = None
-        if cfg.fed.server_opt != "none":
-            from fedtpu.ops.server_opt import make_server_optimizer
-            server = make_server_optimizer(
-                cfg.fed.server_opt, learning_rate=cfg.fed.server_lr,
-                momentum=cfg.fed.server_momentum, b1=cfg.fed.server_b1,
-                b2=cfg.fed.server_b2, tau=cfg.fed.server_tau)
-        elif cfg.fed.dp_clip_norm > 0:
-            # DP with plain averaging still runs the delta path and needs
-            # the (empty-momentum) server state initialized.
-            from fedtpu.ops.server_opt import identity_server_optimizer
-            server = identity_server_optimizer()
         state_fn = lambda: init_federated_state(
             jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
             init_fn, tx, same_init=cfg.fed.same_init, server_opt=server,

@@ -33,12 +33,23 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fedtpu.ops.server_opt import (ServerOptimizer, clip_by_global_norm,
+                                   gaussian_noise_tree,
+                                   identity_server_optimizer)
 from fedtpu.parallel.mesh import CLIENTS_AXIS, trim_to_divisor
-from fedtpu.parallel.round import assemble_metrics, client_init_keys
+from fedtpu.parallel.round import (_DP_NOISE_STREAM, assemble_metrics,
+                                   client_init_keys)
 from fedtpu.training.client import (make_local_eval_step,
                                     make_local_train_step)
 
 MODEL_AXIS = "model"
+
+
+def drop_client_axis(spec: P) -> P:
+    """The per-leaf layout of a GLOBAL (clients-free) tensor: the same spec
+    with the leading clients entry removed — server-optimizer state shards
+    over 'model' exactly like the params it mirrors."""
+    return P(*tuple(spec)[1:])
 
 
 def make_mesh_2d(model_parallel: int, num_clients: int = 0,
@@ -125,16 +136,35 @@ def tp_specs(params) -> dict:
 def init_federated_state_2d(key: jax.Array, mesh: Mesh, num_clients: int,
                             init_fn: Callable,
                             tx: optax.GradientTransformation,
-                            same_init: bool = False) -> dict:
+                            same_init: bool = False,
+                            server_opt: ServerOptimizer | None = None
+                            ) -> dict:
     """Global-view per-client state laid out on the 2-D mesh. Optimizer
-    moments inherit the param shardings via jit sharding propagation."""
+    moments inherit the param shardings via jit sharding propagation.
+
+    ``server_opt`` mirrors the 1-D engine (fedtpu.parallel.round): the
+    server model is the uniform mean of the client inits, every client
+    starts FROM it, and ``server_opt_state`` (clients-free pytrees) lays
+    out with the client axis dropped — model-sharded like the params."""
     params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
     specs = tp_specs(params)
+    if server_opt is not None:
+        g0 = jax.tree.map(lambda p: p.mean(axis=0), params)
+        params = jax.tree.map(
+            lambda g, p: jnp.broadcast_to(g[None], p.shape), g0, params)
     params = jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
     opt_state = jax.jit(jax.vmap(tx.init))(params)
-    return {"params": params, "opt_state": opt_state,
-            "round": jnp.zeros((), jnp.int32)}
+    state = {"params": params, "opt_state": opt_state,
+             "round": jnp.zeros((), jnp.int32)}
+    if server_opt is not None:
+        g0 = jax.tree.map(lambda p: p[0], params)
+        sstate0 = server_opt.init(g0)
+        sspecs = jax.tree.map(drop_client_axis, specs)
+        state["server_opt_state"] = jax.tree.map(
+            lambda t, s: jax.device_put(t, NamedSharding(mesh, s)),
+            sstate0, {k: sspecs for k in sstate0})
+    return state
 
 
 def batch_sharding_2d(mesh: Mesh) -> NamedSharding:
@@ -147,16 +177,36 @@ def build_round_fn_2d(mesh: Mesh, apply_fn: Callable,
                       weighting: str = "data_size",
                       rounds_per_step: int = 1,
                       local_steps: int = 1,
-                      prox_mu: float = 0.0) -> Callable:
+                      prox_mu: float = 0.0,
+                      server_opt: ServerOptimizer | None = None,
+                      dp_clip_norm: float = 0.0,
+                      dp_noise_multiplier: float = 0.0,
+                      dp_seed: int = 0) -> Callable:
     """The federated round as a global-view jit program on the 2-D mesh.
     Semantics mirror fedtpu.parallel.round.build_round_fn: ``local_steps``
     full-batch steps per client (default 1 == the reference cadence), an
     optional FedProx term (``prox_mu``), then the weighted average of
     FL_CustomMLP...:108-119 as a plain tensordot over the clients axis —
-    GSPMD lowers it to the cross-device reduction."""
+    GSPMD lowers it to the cross-device reduction.
+
+    ``server_opt`` / ``dp_clip_norm`` / ``dp_noise_multiplier`` enable the
+    same DELTA aggregation as the 1-D engine (FedOpt server optimizers,
+    DP-FedAvg clip+noise). Global view makes it direct: the mean client
+    delta and server state are ordinary clients-free tensors; GSPMD
+    replicates/shards them (server state lays out model-sharded like the
+    params it mirrors). No client sampling here, so the DP denominator is
+    always the realized participant weight."""
     local_train = make_local_train_step(apply_fn, tx, local_steps=local_steps,
                                         prox_mu=prox_mu)
     local_eval = make_local_eval_step(apply_fn, num_classes)
+
+    delta_path = (server_opt is not None or dp_clip_norm > 0
+                  or dp_noise_multiplier > 0)
+    if dp_noise_multiplier > 0 and dp_clip_norm <= 0:
+        raise ValueError("dp_noise_multiplier requires dp_clip_norm > 0 "
+                         "(noise std is noise_multiplier * clip / weight)")
+    if delta_path and server_opt is None:
+        server_opt = identity_server_optimizer()
 
     def constrain(params, specs):
         return jax.tree.map(
@@ -165,11 +215,19 @@ def build_round_fn_2d(mesh: Mesh, apply_fn: Callable,
 
     @jax.jit
     def round_step(state, batch):
+        if delta_path and "server_opt_state" not in state:
+            raise ValueError(
+                "delta aggregation (server_opt / DP) needs state from "
+                "init_federated_state_2d(..., server_opt=...) — "
+                "'server_opt_state' missing")
         x, y, mask = batch["x"], batch["y"], batch["mask"]
         specs = tp_specs(state["params"])
+        sspecs = jax.tree.map(drop_client_axis, specs)
+        sstate0 = state.get("server_opt_state", ())
 
         def one_round(carry, _):
-            params, opt_state = carry
+            params, opt_state, sstate, r = carry
+            start = params
             params, opt_state, loss = jax.vmap(local_train)(
                 params, opt_state, x, y, mask)
             # Evaluate BEFORE averaging — reference ordering: evaluate_local
@@ -179,28 +237,59 @@ def build_round_fn_2d(mesh: Mesh, apply_fn: Callable,
             w = n if weighting == "data_size" else jnp.ones_like(n)
             tw_raw = w.sum()
             tw = jnp.maximum(tw_raw, 1.0)
-            avg = jax.tree.map(
-                lambda p: jnp.tensordot(w.astype(jnp.float32),
-                                        p.astype(jnp.float32), axes=1) / tw,
-                params)
-            # Zero total weight (every shard empty): keep params unchanged,
-            # matching the 1-D engine's skip-averaging guard.
-            params = jax.tree.map(
-                lambda a, p: jnp.where(
-                    tw_raw > 0,
-                    jnp.broadcast_to(a[None], p.shape).astype(p.dtype), p),
-                avg, params)
+
+            def wmean(p):
+                return jnp.tensordot(w.astype(jnp.float32),
+                                     p.astype(jnp.float32), axes=1) / tw
+
+            if delta_path:
+                delta = jax.tree.map(lambda t, s: t - s, params, start)
+                if dp_clip_norm > 0:
+                    delta, _ = clip_by_global_norm(delta, dp_clip_norm)
+                mean_delta = jax.tree.map(wmean, delta)
+                if dp_noise_multiplier > 0:
+                    std = dp_noise_multiplier * dp_clip_norm / tw
+                    noise_key = jax.random.fold_in(
+                        jax.random.fold_in(jax.random.key(dp_seed),
+                                           _DP_NOISE_STREAM), r)
+                    mean_delta = jax.tree.map(
+                        jnp.add, mean_delta,
+                        gaussian_noise_tree(noise_key, mean_delta, std))
+                step, sstate = server_opt.update(mean_delta, sstate)
+                sstate = jax.tree.map(
+                    lambda t, s: jax.lax.with_sharding_constraint(
+                        t, NamedSharding(mesh, s)),
+                    sstate, {k: sspecs for k in sstate})
+                g = jax.tree.map(lambda s: s[0], start)  # slots identical
+                params = jax.tree.map(
+                    lambda gl, st, p: jnp.broadcast_to(
+                        (gl + st)[None], p.shape).astype(p.dtype),
+                    g, step, params)
+            else:
+                avg = jax.tree.map(wmean, params)
+                # Zero total weight (every shard empty): keep params
+                # unchanged, matching the 1-D engine's guard.
+                params = jax.tree.map(
+                    lambda a, p: jnp.where(
+                        tw_raw > 0,
+                        jnp.broadcast_to(a[None],
+                                         p.shape).astype(p.dtype), p),
+                    avg, params)
             # Keep the broadcast result on the declared 2-D layout rather
             # than letting GSPMD pick (e.g. full replication).
             params = constrain(params, specs)
-            return (params, opt_state), (loss, conf, conf.sum(axis=0))
+            return (params, opt_state, sstate, r + 1), (loss, conf,
+                                                        conf.sum(axis=0))
 
-        (params, opt_state), (loss, conf, pooled) = jax.lax.scan(
-            one_round, (state["params"], state["opt_state"]),
+        (params, opt_state, sstate, _), (loss, conf, pooled) = jax.lax.scan(
+            one_round,
+            (state["params"], state["opt_state"], sstate0, state["round"]),
             length=rounds_per_step)
         metrics = assemble_metrics(loss, conf, pooled, mask, rounds_per_step)
         new_state = {"params": params, "opt_state": opt_state,
                      "round": state["round"] + rounds_per_step}
+        if delta_path:
+            new_state["server_opt_state"] = sstate
         return new_state, metrics
 
     return round_step

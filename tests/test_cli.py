@@ -66,3 +66,64 @@ def test_sweep_honors_local_steps(tmp_path, monkeypatch):
     main(["sweep", "--csv", "", "--num-clients", "2", "--local-steps", "7",
           "--quiet"])
     assert seen.get("local_steps") == 7
+
+
+def test_run_new_aggregation_flags_reach_config(monkeypatch):
+    """--server-opt / --dp-* / --compress / --robust-* / --byzantine-clients
+    must land in FedConfig (a dropped override silently runs the wrong
+    experiment)."""
+    import fedtpu.cli as cli
+    captured = {}
+
+    def spy(cfg, verbose=True, resume=False):
+        captured["fed"] = cfg.fed
+
+        class R:
+            def summary(self):
+                return {}
+        return R()
+
+    import fedtpu.orchestration.loop as loop
+    monkeypatch.setattr(loop, "run_experiment", spy)
+    rc = cli.main(["run", "--csv", "", "--rounds", "1",
+                   "--server-opt", "fedyogi", "--server-lr", "0.05",
+                   "--server-momentum", "0.8",
+                   "--dp-clip-norm", "2.0", "--dp-noise-multiplier", "0.2",
+                   "--weighting", "uniform", "--quiet"])
+    assert rc == 0
+    fed = captured["fed"]
+    assert fed.server_opt == "fedyogi"
+    assert fed.server_lr == 0.05
+    assert fed.server_momentum == 0.8
+    assert fed.dp_clip_norm == 2.0
+    assert fed.dp_noise_multiplier == 0.2
+
+    rc = cli.main(["run", "--csv", "", "--rounds", "1",
+                   "--compress", "int8", "--quiet"])
+    assert rc == 0
+    assert captured["fed"].compress == "int8"
+
+    rc = cli.main(["run", "--csv", "", "--rounds", "1",
+                   "--weighting", "uniform",
+                   "--robust-aggregation", "krum", "--krum-f", "1",
+                   "--byzantine-clients", "1", "--quiet"])
+    assert rc == 0
+    fed = captured["fed"]
+    assert fed.robust_aggregation == "krum"
+    assert fed.krum_f == 1
+    assert fed.byzantine_clients == 1
+
+    rc = cli.main(["run", "--csv", "", "--rounds", "1",
+                   "--weighting", "uniform",
+                   "--robust-aggregation", "trimmed_mean",
+                   "--trim-ratio", "0.2", "--quiet"])
+    assert rc == 0
+    assert captured["fed"].trim_ratio == 0.2
+
+
+def test_run_compress_end_to_end_via_cli(capsys):
+    rc = main(["run", "--csv", "", "--rounds", "2", "--num-clients", "4",
+               "--compress", "int8", "--quiet", "--json"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["rounds_run"] == 2

@@ -254,19 +254,90 @@ def test_dp_with_sampling_rejects_data_size_weighting():
                participation_rate=0.5)
 
 
-def test_2d_engine_rejects_noise_only_dp():
-    import pytest
-    from fedtpu.orchestration.loop import build_experiment
+def test_2d_engine_fedavgm_identity_matches_vanilla_2d():
+    # The 1-D invariant holds on the 2-D tensor-parallel engine too:
+    # fedavgm(momentum=0, lr=1) == parameter averaging.
+    from fedtpu.parallel import tp
+    x, y = synthetic_income_like(256, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=8, shuffle=False))
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8, 8)))
+    tx = build_optimizer(OptimConfig())
+    mesh = tp.make_mesh_2d(2, 8)
+    batch = {k: jax.device_put(v, tp.batch_sharding_2d(mesh)) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+
+    ident = make_server_optimizer("fedavgm", learning_rate=1.0, momentum=0.0)
+    v_state = tp.init_federated_state_2d(jax.random.key(1), mesh, 8,
+                                         init_fn, tx, same_init=True)
+    d_state = tp.init_federated_state_2d(jax.random.key(1), mesh, 8,
+                                         init_fn, tx, same_init=True,
+                                         server_opt=ident)
+    v_step = tp.build_round_fn_2d(mesh, apply_fn, tx, 2)
+    d_step = tp.build_round_fn_2d(mesh, apply_fn, tx, 2, server_opt=ident)
+    for _ in range(3):
+        v_state, _ = v_step(v_state, batch)
+        d_state, _ = d_step(d_state, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-5),
+        v_state["params"], d_state["params"])
+
+
+def test_2d_engine_fedadam_matches_1d_engine():
+    # Same FedAdam round on both engines, same init/data: identical
+    # trajectories up to collective reassociation.
+    from fedtpu.parallel import tp
+    from fedtpu.parallel import make_mesh, client_sharding
+    x, y = synthetic_income_like(256, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=8, shuffle=False))
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8, 8)))
+    tx = build_optimizer(OptimConfig())
+    server = make_server_optimizer("fedadam", learning_rate=0.02)
+    key = jax.random.key(1)
+
+    mesh1 = make_mesh(num_clients=8)
+    s1 = init_federated_state(key, mesh1, 8, init_fn, tx, same_init=True,
+                              server_opt=server)
+    b1 = {k: jax.device_put(v, client_sharding(mesh1)) for k, v in
+          {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    step1 = build_round_fn(mesh1, apply_fn, tx, 2, server_opt=server)
+
+    mesh2 = tp.make_mesh_2d(2, 8)
+    s2 = tp.init_federated_state_2d(key, mesh2, 8, init_fn, tx,
+                                    same_init=True, server_opt=server)
+    b2 = {k: jax.device_put(v, tp.batch_sharding_2d(mesh2)) for k, v in
+          {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    step2 = tp.build_round_fn_2d(mesh2, apply_fn, tx, 2, server_opt=server)
+
+    for _ in range(3):
+        s1, m1 = step1(s1, b1)
+        s2, m2 = step2(s2, b2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=2e-5),
+        s1["params"], s2["params"])
+    np.testing.assert_allclose(float(m1["client_mean"]["accuracy"]),
+                               float(m2["client_mean"]["accuracy"]),
+                               atol=1e-6)
+
+
+def test_2d_engine_runs_dp_via_loop():
+    from fedtpu.orchestration.loop import run_experiment
     cfg = ExperimentConfig(
-        data=DataConfig(csv_path=None, synthetic_rows=128,
+        data=DataConfig(csv_path=None, synthetic_rows=256,
                         synthetic_features=6),
-        shard=ShardConfig(num_clients=4),
+        shard=ShardConfig(num_clients=4, shuffle=False),
         model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
-        fed=FedConfig(dp_noise_multiplier=1.0),
-        run=RunConfig(model_parallel=2),
+        fed=FedConfig(rounds=4, server_opt="fedyogi", server_lr=0.02,
+                      dp_clip_norm=1.0, dp_noise_multiplier=0.05,
+                      weighting="uniform"),
+        run=RunConfig(model_parallel=2, rounds_per_step=2),
     )
-    with pytest.raises(ValueError, match="1-D engine"):
-        build_experiment(cfg)
+    result = run_experiment(cfg, verbose=False)
+    assert result.rounds_run == 4
+    assert all(np.isfinite(v) for v in result.global_metrics["accuracy"])
 
 
 def test_dp_noise_requires_clip():
@@ -295,8 +366,7 @@ def test_run_experiment_with_fedadam_and_dp():
     assert all(np.isfinite(v) for v in result.global_metrics["accuracy"])
 
 
-def test_2d_engine_rejects_server_opt():
-    import pytest
+def test_2d_engine_builds_server_opt_state():
     from fedtpu.orchestration.loop import build_experiment
     cfg = ExperimentConfig(
         data=DataConfig(csv_path=None, synthetic_rows=128,
@@ -306,5 +376,25 @@ def test_2d_engine_rejects_server_opt():
         fed=FedConfig(server_opt="fedadam"),
         run=RunConfig(model_parallel=2),
     )
-    with pytest.raises(ValueError, match="1-D engine"):
-        build_experiment(cfg)
+    exp = build_experiment(cfg)
+    assert "server_opt_state" in exp.state
+    # Server second moments are clients-free and model-sharded like the
+    # hidden params they mirror.
+    m0 = exp.state["server_opt_state"]["v"]["layers"][0]["w"]
+    assert m0.ndim == 2   # (in, hidden) — no client axis
+
+
+def test_noise_only_dp_fails_fast_on_both_engines():
+    import pytest
+    from fedtpu.orchestration.loop import build_experiment
+    for mp in (1, 2):
+        cfg = ExperimentConfig(
+            data=DataConfig(csv_path=None, synthetic_rows=128,
+                            synthetic_features=6),
+            shard=ShardConfig(num_clients=4),
+            model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
+            fed=FedConfig(dp_noise_multiplier=1.0),
+            run=RunConfig(model_parallel=mp),
+        )
+        with pytest.raises(ValueError, match="dp_clip_norm"):
+            build_experiment(cfg)
