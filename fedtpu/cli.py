@@ -184,6 +184,9 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         fed = dataclasses.replace(fed, trim_ratio=args.trim_ratio)
     if args.krum_f is not None:
         fed = dataclasses.replace(fed, krum_f=args.krum_f)
+    if getattr(args, "personalize_steps", None) is not None:
+        fed = dataclasses.replace(fed,
+                                  personalize_steps=args.personalize_steps)
     if args.byzantine_clients is not None:
         fed = dataclasses.replace(fed,
                                   byzantine_clients=args.byzantine_clients)
@@ -230,6 +233,13 @@ def main(argv=None) -> int:
     run_p.add_argument("--resume", action="store_true",
                        help="resume from the latest checkpoint in "
                             "--checkpoint-dir")
+    # run-only, like --aggregation: the sweep/parity programs would accept
+    # but silently ignore it.
+    run_p.add_argument("--personalize-steps", type=_positive_int,
+                       default=None,
+                       help="post-training per-client fine-tuning steps "
+                            "from the final global model (personalized "
+                            "metrics in the summary)")
 
     sweep_p = sub.add_parser("sweep", help="federated hyperparameter grid")
     _add_common_overrides(sweep_p)

@@ -185,6 +185,12 @@ class FedConfig:
     # it targets; at large D plain psum wins, hence default 'none'. Plain
     # averaging only (not server_opt/DP); aggregation='psum'; 1-D engine.
     compress: str = "none"
+    # Post-training per-client personalization: E local full-batch
+    # fine-tuning steps from the final global model, fresh optimizer, no
+    # further averaging (fedtpu.training.personalize). 0 = off. The
+    # personalized per-client metrics land in
+    # ExperimentResult.personalized_metrics.
+    personalize_steps: int = 0
     # Each client starts from an independent random init, matching the
     # reference where every rank constructs an unseeded torch model
     # (FL_CustomMLP...:42). Set True to start all clients identical.

@@ -76,9 +76,17 @@ class ExperimentResult:
     config: ExperimentConfig
     # True when the non-finite guard (RunConfig.halt_on_nonfinite) fired.
     diverged: bool = False
+    # per-client metrics after post-training local fine-tuning
+    # (FedConfig.personalize_steps > 0): {"per_client": {name: (C,)},
+    # "client_mean": {name: float}}. Empty dict when personalization is off.
+    personalized_metrics: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)
 
     def summary(self) -> dict:
         last = {k: v[-1] for k, v in self.global_metrics.items() if v}
+        extra = ({"personalized_client_mean":
+                  self.personalized_metrics.get("client_mean")}
+                 if self.personalized_metrics else {})
         # Exclude the first chunk's entries from the mean: its compile time is
         # smeared over rounds_per_step per-round entries, not just the first.
         warm = max(1, self.config.run.rounds_per_step)
@@ -90,6 +98,7 @@ class ExperimentResult:
             "diverged": self.diverged,
             "final_global_metrics": last,
             "mean_sec_per_round": float(np.mean(steady)),
+            **extra,
         }
 
 
@@ -103,6 +112,8 @@ class Experiment:
     eval_step: Callable
     dataset: Dataset
     mesh: object
+    # Post-training per-client fine-tune (FedConfig.personalize_steps > 0).
+    personalize_fn: Optional[Callable] = None
 
 
 def build_experiment(cfg: ExperimentConfig,
@@ -230,8 +241,14 @@ def build_experiment(cfg: ExperimentConfig,
         eval_apply = fused_mlp_forward
 
     eval_step = build_eval_fn(eval_apply, ds.num_classes)
+    personalize_fn = None
+    if cfg.fed.personalize_steps > 0:
+        from fedtpu.training.personalize import build_personalize_fn
+        personalize_fn = build_personalize_fn(apply_fn, tx, ds.num_classes,
+                                              cfg.fed.personalize_steps)
     return Experiment(make_step=step_fn, state=state, batch=batch,
-                      eval_step=eval_step, dataset=ds, mesh=mesh)
+                      eval_step=eval_step, dataset=ds, mesh=mesh,
+                      personalize_fn=personalize_fn)
 
 
 @jax.jit
@@ -492,6 +509,25 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         if jsonl is not None:
             jsonl.close()
 
+    personalized: Dict[str, dict] = {}
+    if exp.personalize_fn is not None and not diverged:
+        # Post-training per-client fine-tune from the final global model;
+        # the personalized models are reported, not kept (the returned
+        # final_params stay the GLOBAL model, which is what checkpoints and
+        # downstream eval use).
+        _, pm = exp.personalize_fn(state["params"], batch)
+        personalized = {
+            "per_client": {k: np.asarray(v)
+                           for k, v in pm["per_client"].items()},
+            "client_mean": {k: float(v)
+                            for k, v in pm["client_mean"].items()},
+        }
+        if verbose:
+            vals = ", ".join(f"{k}: {v:.4f}"
+                             for k, v in personalized["client_mean"].items())
+            print(f"Personalized ({cfg.fed.personalize_steps} local steps) "
+                  f"client-mean: [{vals}]", flush=True)
+
     return ExperimentResult(
         global_metrics=history,
         pooled_metrics=pooled_hist,
@@ -504,4 +540,5 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         final_params=to_numpy(global_params(state)),
         config=cfg,
         diverged=diverged,
+        personalized_metrics=personalized,
     )

@@ -547,6 +547,18 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     return round_step
 
 
+def masked_client_mean(per_client, mask):
+    """Mean over clients excluding empty shards — THE client-mean
+    convention (one dataless client must not deflate the global metric /
+    early-stop signal). ``per_client`` leaves end in a clients axis
+    (``(..., C)``); ``mask`` is the ``(C, N)`` sample mask. Shared by the
+    round programs and post-training personalization."""
+    nonempty = (mask.sum(axis=1) > 0).astype(jnp.float32)
+    denom = jnp.maximum(nonempty.sum(), 1.0)
+    return jax.tree.map(lambda v: (v * nonempty).sum(axis=-1) / denom,
+                        per_client)
+
+
 def assemble_metrics(loss, conf, pooled_conf, mask, rounds_per_step: int):
     """Per-round metric dicts from stacked confusion matrices; shared by the
     shard_map engine above and the GSPMD 2-D engine (fedtpu.parallel.tp).
@@ -557,14 +569,10 @@ def assemble_metrics(loss, conf, pooled_conf, mask, rounds_per_step: int):
     early-stop signal. (The reference's sklearn scripts likewise skip
     dataless ranks, FL_SkLearn...:91-93.)"""
     per_client = jax.vmap(jax.vmap(metrics_from_confusion))(conf)
-    nonempty = (mask.sum(axis=1) > 0).astype(jnp.float32)
-    denom = jnp.maximum(nonempty.sum(), 1.0)
     metrics = {
         "loss": loss,
         "per_client": per_client,
-        "client_mean": jax.tree.map(
-            lambda v: (v * nonempty[None, :]).sum(axis=1) / denom,
-            per_client),
+        "client_mean": masked_client_mean(per_client, mask),
         "pooled": jax.vmap(metrics_from_confusion)(pooled_conf),
     }
     if rounds_per_step == 1:
