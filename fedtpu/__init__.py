@@ -46,6 +46,10 @@ _LAZY = {
     "client_sharding": ("fedtpu.parallel.mesh", "client_sharding"),
     "build_round_fn": ("fedtpu.parallel.round", "build_round_fn"),
     "init_federated_state": ("fedtpu.parallel.round", "init_federated_state"),
+    "make_server_optimizer": ("fedtpu.ops.server_opt",
+                              "make_server_optimizer"),
+    "build_personalize_fn": ("fedtpu.training.personalize",
+                             "build_personalize_fn"),
 }
 
 

@@ -192,3 +192,13 @@ def test_looks_multihost_env_detection(monkeypatch):
     assert not multihost._looks_multihost()
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
     assert multihost._looks_multihost()
+
+
+def test_lazy_top_level_api_resolves():
+    """Every name in fedtpu._LAZY resolves to a callable via PEP 562 —
+    a renamed/moved symbol breaks `fedtpu.<name>` for users even though
+    direct module imports still pass."""
+    import fedtpu
+    for name in fedtpu._LAZY:
+        assert callable(getattr(fedtpu, name)), name
+    assert set(fedtpu._LAZY) <= set(dir(fedtpu))
