@@ -50,3 +50,39 @@ def test_no_early_stop_when_metrics_move():
                               tolerance=1e-12), verbose=False)
     assert not res.stopped_early
     assert res.rounds_run == 8
+
+
+def test_run_experiment_is_deterministic():
+    """Same config, two runs, identical metric histories (client-mean,
+    pooled, per-client, test, personalized) and final params — the
+    reproducibility guarantee the reference undermines with unseeded
+    per-rank shuffles (SURVEY.md §2a _split_data)."""
+    import jax
+    from fedtpu.config import ModelConfig
+
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=8, shuffle=True, shard_seed=5),
+        model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
+        fed=FedConfig(rounds=6, participation_rate=0.7,
+                      personalize_steps=3),
+        run=RunConfig(rounds_per_step=3, eval_test_every=3),
+    )
+    a = run_experiment(cfg, verbose=False)
+    b = run_experiment(cfg, verbose=False)
+    for k in a.global_metrics:
+        np.testing.assert_array_equal(a.global_metrics[k],
+                                      b.global_metrics[k])
+        np.testing.assert_array_equal(a.pooled_metrics[k],
+                                      b.pooled_metrics[k])
+        np.testing.assert_array_equal(a.per_client_metrics[k],
+                                      b.per_client_metrics[k])
+        np.testing.assert_array_equal(a.test_metrics[k], b.test_metrics[k])
+        np.testing.assert_array_equal(
+            a.personalized_metrics["per_client"][k],
+            b.personalized_metrics["per_client"][k])
+    jax.tree.map(np.testing.assert_array_equal, a.final_params,
+                 b.final_params)
+    assert (a.personalized_metrics["client_mean"]
+            == b.personalized_metrics["client_mean"])
