@@ -94,7 +94,8 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                         "psum traffic at D devices; for few-host DCN-bound "
                         "aggregation)")
     p.add_argument("--robust-aggregation",
-                   choices=["none", "median", "trimmed_mean", "krum"],
+                   choices=["none", "median", "trimmed_mean", "krum",
+                            "geometric_median"],
                    default=None,
                    help="Byzantine-robust aggregation rule (requires "
                         "--weighting uniform and full participation)")

@@ -169,7 +169,8 @@ class FedConfig:
     # Byzantine-robust aggregation: 'none' (weighted mean — the reference's
     # rule) | 'median' (coordinate-wise) | 'trimmed_mean' (drop trim_ratio
     # from each end per coordinate) | 'krum' (select the single client
-    # update closest to its C - krum_f - 2 nearest peers). Robust rules are
+    # update closest to its C - krum_f - 2 nearest peers) |
+    # 'geometric_median' (smoothed Weiszfeld / RFA). Robust rules are
     # unweighted, so weighting='uniform' is required (making the semantics
     # explicit); full participation + plain psum path only.
     # byzantine_clients injects k model-poisoning clients (10x sign-flipped

@@ -28,6 +28,7 @@ of hyperparameters_tuning.py:37. Optimizer state is deliberately NOT averaged
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Callable
 
@@ -247,10 +248,12 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                          "aggregation='psum' with it")
     qmean = (make_quantized_weighted_mean(CLIENTS_AXIS)
              if compress == "int8" else None)
-    if robust_aggregation not in ("none", "median", "trimmed_mean", "krum"):
+    if robust_aggregation not in ("none", "median", "trimmed_mean", "krum",
+                                  "geometric_median"):
         raise ValueError(f"unknown robust_aggregation "
                          f"{robust_aggregation!r}; available: 'none', "
-                         "'median', 'trimmed_mean', 'krum'")
+                         "'median', 'trimmed_mean', 'krum', "
+                         "'geometric_median'")
     robust = robust_aggregation != "none"
     if robust and (delta_path or compress != "none"
                    or aggregation != "psum"):
@@ -427,16 +430,52 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                                             CLIENTS_AXIS)   # (D, Cb, ...)
                     return pg.reshape((-1,) + pg.shape[2:])  # (C, ...)
 
-                if robust_aggregation == "krum":
+                whole_update_rule = robust_aggregation in ("krum",
+                                                           "geometric_median")
+                if whole_update_rule:
+                    # krum and geometric_median both work on the JOINT
+                    # flattened update per client — one shared
+                    # gather/flatten (and its inverse below).
+                    gathered = jax.tree.map(gather_clients, agg_params)
+                    leaves = jax.tree.leaves(gathered)
+                    flat = jnp.concatenate(
+                        [g.reshape(num_clients, -1) for g in leaves], axis=1)
+
+                if robust_aggregation == "geometric_median":
+                    # Smoothed Weiszfeld (the RFA rule, Pillutla et al.):
+                    # iterate u <- sum_i u_i/max(||u_i - u||, eps) /
+                    # sum_i 1/max(||u_i - u||, eps) from the mean — the
+                    # point minimizing the SUM of distances to client
+                    # updates, robust to any <50% corrupted minority.
+                    mu = flat.mean(axis=0)
+
+                    def weiszfeld(u, _):
+                        d = jnp.sqrt(jnp.sum(jnp.square(flat - u), axis=1))
+                        wgt = 1.0 / jnp.maximum(d, 1e-8)
+                        return ((wgt[:, None] * flat).sum(axis=0)
+                                / wgt.sum()), None
+
+                    mu, _ = jax.lax.scan(weiszfeld, mu, length=10)
+                    offsets = [0]
+                    for l in leaves:
+                        offsets.append(offsets[-1]
+                                       + math.prod(l.shape[1:]))
+                    flat_leaves = [
+                        mu[offsets[i]:offsets[i + 1]].reshape(
+                            leaves[i].shape[1:])
+                        for i in range(len(leaves))]
+                    glob = jax.tree.unflatten(
+                        jax.tree.structure(gathered), flat_leaves)
+                    params = jax.tree.map(
+                        lambda gl, p: jnp.broadcast_to(
+                            gl[None], p.shape).astype(p.dtype),
+                        glob, agg_params)
+                elif robust_aggregation == "krum":
                     # Blanchard et al. 2017: score each client by the sum
                     # of squared distances to its C - f - 2 nearest peers;
                     # the winner's whole update becomes the global. MXU
                     # form: pairwise distances via the gram matrix of the
                     # flattened updates.
-                    gathered = jax.tree.map(gather_clients, agg_params)
-                    flat = jnp.concatenate(
-                        [g.reshape(num_clients, -1)
-                         for g in jax.tree.leaves(gathered)], axis=1)
                     # Pairwise distances are invariant under any common
                     # shift: center on the client mean BEFORE the gram
                     # matrix, so the shared model magnitude (>> per-client

@@ -136,7 +136,7 @@ def test_byzantine_composes_with_dp_clipping():
 
 def test_robust_rejects_bad_combos():
     with pytest.raises(ValueError, match="unknown robust_aggregation"):
-        _setup(robust_aggregation="geometric_median")
+        _setup(robust_aggregation="rfa_typo")
     with pytest.raises(ValueError, match="full participation"):
         _setup(robust_aggregation="median", weighting="uniform",
                participation_rate=0.5)
@@ -235,3 +235,66 @@ def test_krum_centering_survives_large_common_offset():
     new_state, _ = step(state, batch)
     after = _leaf0(new_state)
     np.testing.assert_allclose(after[0], expected, rtol=1e-6)
+
+
+def test_geometric_median_matches_numpy_weiszfeld():
+    state, batch, step = _setup(lr=0.0,
+                                robust_aggregation="geometric_median",
+                                weighting="uniform")
+    mesh = make_mesh(num_clients=8)
+    init_fn, _ = build_model(ModelConfig(input_dim=6, hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig(learning_rate=0.0))
+    state = init_federated_state(jax.random.key(3), mesh, 8, init_fn, tx,
+                                 same_init=False)
+    flat = np.concatenate(
+        [np.asarray(l).reshape(8, -1)
+         for l in jax.tree.leaves(state["params"])], axis=1)
+    u = flat.mean(axis=0)
+    for _ in range(10):                       # same smoothed Weiszfeld
+        d = np.sqrt(((flat - u) ** 2).sum(axis=1))
+        w = 1.0 / np.maximum(d, 1e-8)
+        u = (w[:, None] * flat).sum(axis=0) / w.sum()
+    leaf0_size = _leaf0(state)[0].size
+    expected = u[:leaf0_size].reshape(_leaf0(state)[0].shape)
+
+    new_state, _ = step(state, batch)
+    after = _leaf0(new_state)
+    for c in range(8):
+        np.testing.assert_allclose(after[c], expected, atol=1e-5)
+
+
+def test_geometric_median_resists_byzantine_minority():
+    kw = dict(byzantine_clients=2, weighting="uniform")
+    g_state, batch, g_step = _setup(
+        robust_aggregation="geometric_median", **kw)
+    h_state, _, h_step = _setup(robust_aggregation="none",
+                                weighting="uniform")
+    start = _leaf0(g_state)[0]
+    g_state, _ = g_step(g_state, batch)
+    h_state, _ = h_step(h_state, batch)
+    honest_move = np.abs(_leaf0(h_state)[0] - start).max()
+    gm_move = np.abs(_leaf0(g_state)[0] - start).max()
+    assert gm_move <= 3 * honest_move
+
+
+def test_trimmed_mean_robustness_needs_enough_trim():
+    """Trimmed mean survives k attackers ONLY when trim_ratio * C >= k
+    (every poisoned value must fall in the trimmed tail). At C=8 with a
+    2-client sign-flip attack: trim_ratio=0.25 (trims 2 per end) converges;
+    the default 0.1 (trims 1) keeps one 10x-poisoned update in the mean,
+    which drags every round's step backward — accuracy collapses. The
+    requirement is documented, not hidden."""
+    kw = dict(byzantine_clients=2, weighting="uniform")
+    enough_state, batch, enough_step = _setup(
+        robust_aggregation="trimmed_mean", trim_ratio=0.25, **kw)  # trims 2
+    thin_state, _, thin_step = _setup(
+        robust_aggregation="trimmed_mean", trim_ratio=0.1, **kw)   # trims 1
+
+    for _ in range(30):
+        enough_state, em = enough_step(enough_state, batch)
+        thin_state, tm = thin_step(thin_state, batch)
+
+    acc_enough = float(em["client_mean"]["accuracy"])
+    acc_thin = float(tm["client_mean"]["accuracy"])
+    assert acc_enough > 0.7      # trim 2 >= 2 attackers: converges
+    assert acc_thin < 0.55       # trim 1 < 2 attackers: the attack wins
