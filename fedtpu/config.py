@@ -89,6 +89,8 @@ class ModelConfig:
     BASELINE.json config-5 CIFAR-10 stress model (new, no reference analogue)."""
 
     kind: str = "mlp"                    # 'mlp' | 'convnet'
+    # () degenerates the MLP to a single Linear — multinomial logistic
+    # regression (pinned by tests/test_round_smoke.py).
     hidden_sizes: Tuple[int, ...] = (50, 200)  # FL_CustomMLP...:40
     num_classes: int = 2
     input_dim: int = 14                  # income CSV feature count

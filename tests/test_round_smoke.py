@@ -48,3 +48,35 @@ def test_round_runs_on_8_device_mesh():
     ev = build_eval_fn(apply_fn, 2)
     m = ev(global_params(state), batch["x"][0], batch["y"][0])
     assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+
+def test_empty_hidden_sizes_is_logistic_regression():
+    """hidden_sizes=() degenerates the MLP family to a single Linear —
+    multinomial logistic regression — and the whole stack (init, round,
+    averaging, metrics) handles it: the smallest model family a reference
+    user might bring."""
+    from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                               RunConfig)
+    from fedtpu.orchestration.loop import run_experiment
+
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=()))
+    params = init_fn(jax.random.key(0))
+    assert len(params["layers"]) == 1           # one Linear: logits head
+    assert params["layers"][0]["w"].shape == (6, 2)
+
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=8, shuffle=False),
+        model=ModelConfig(input_dim=6, hidden_sizes=()),
+        # Early stop disabled: a linear model saturating the separable
+        # synthetic data within atol=1e-4 would otherwise stop the run and
+        # fail the rounds_run assertion spuriously.
+        fed=FedConfig(rounds=20, termination_patience=10**9),
+        run=RunConfig(rounds_per_step=5),
+    )
+    result = run_experiment(cfg, verbose=False)
+    assert result.rounds_run == 20
+    assert np.isfinite(result.global_metrics["accuracy"][-1])
+    assert result.global_metrics["accuracy"][-1] > 0.6   # separable synth
