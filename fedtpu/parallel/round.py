@@ -52,6 +52,14 @@ from fedtpu.training.client import make_local_train_step, make_local_eval_step
 _DP_NOISE_STREAM = 0x6E6F6973  # "nois"
 
 
+def bcast_global(gl, p):
+    """One global (clients-free) tensor into every client slot of ``p``'s
+    shape and dtype — the in-graph form of the reference's weight broadcast
+    (FL_CustomMLP...:119). Shared by every aggregation path here and in the
+    2-D engine (fedtpu.parallel.tp)."""
+    return jnp.broadcast_to(gl[None], p.shape).astype(p.dtype)
+
+
 def client_init_keys(key: jax.Array, num_clients: int, same_init: bool):
     """Per-client PRNG keys: identical when ``same_init`` (all clients start
     from one model), else split — the reproducible stand-in for the
@@ -102,12 +110,12 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
         # fail fast when handed a state whose slots never started shared
         # (dict membership is static under jit; no runtime cost).
         state["shared_start"] = ()
-    if server_opt is not None:
-        from jax.sharding import NamedSharding
-        g0 = jax.tree.map(lambda p: p[0], state["params"])
-        replicated = NamedSharding(mesh, P())
-        state["server_opt_state"] = jax.tree.map(
-            lambda t: jax.device_put(t, replicated), server_opt.init(g0))
+        if server_opt is not None:
+            from jax.sharding import NamedSharding
+            replicated = NamedSharding(mesh, P())
+            state["server_opt_state"] = jax.tree.map(
+                lambda t: jax.device_put(t, replicated),
+                server_opt.init(g0))
     return state
 
 
@@ -290,6 +298,11 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
             trained, new_opt, loss = jax.vmap(local_train)(
                 params, opt_state, x, y, mask)
 
+            def per_client_where(cond, a, b):
+                # (Cb,) mask broadcast over each leaf's trailing dims.
+                return jnp.where(cond.reshape((cb,) + (1,) * (a.ndim - 1)),
+                                 a, b)
+
             if sampling:
                 # Per-(round, client) Bernoulli draw, deterministic in the
                 # seed — the in-graph analogue of server-side client sampling.
@@ -299,11 +312,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     lambda i: jax.random.uniform(
                         jax.random.fold_in(round_key, i)))(gidx)
                 part = (u < participation_rate).astype(jnp.float32)
-
-                def select(a, b):
-                    shape = (cb,) + (1,) * (a.ndim - 1)
-                    return jnp.where(part.reshape(shape) > 0, a, b)
-
+                select = lambda a, b: per_client_where(part > 0, a, b)
                 params = jax.tree.map(select, trained, params)
                 opt_state = jax.tree.map(
                     lambda a, b: (select(a, b)
@@ -324,13 +333,9 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
             agg_params = params
             if byzantine_clients > 0:
                 bad = gidx < byzantine_clients
-
-                def poison(t, s):
-                    shape = (cb,) + (1,) * (t.ndim - 1)
-                    return jnp.where(bad.reshape(shape),
-                                     s - 10.0 * (t - s), t)
-
-                agg_params = jax.tree.map(poison, params, start)
+                agg_params = jax.tree.map(
+                    lambda t, s: per_client_where(bad, s - 10.0 * (t - s), t),
+                    params, start)
 
             if delta_path:
                 # Weighted mean of per-client UPDATES as a pseudo-gradient
@@ -384,10 +389,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 sstate = new_sstate
                 g = jax.tree.map(lambda s: s[0], start)   # slots identical
                 g_new = jax.tree.map(jnp.add, g, new_step)
-                params = jax.tree.map(
-                    lambda gl, p: jnp.broadcast_to(gl[None],
-                                                   p.shape).astype(p.dtype),
-                    g_new, params)
+                params = jax.tree.map(bcast_global, g_new, params)
             elif compress == "int8":
                 # Bandwidth-lean exchange (fedtpu.parallel.compress): the
                 # new global is reconstructed as start + weighted-mean of
@@ -400,10 +402,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 g = jax.tree.map(lambda s: s[0], start)   # slots identical
 
                 def q_avg(gl, md, p):
-                    out = jnp.broadcast_to((gl + md)[None],
-                                           p.shape).astype(p.dtype)
                     # Zero participants (under sampling): skip averaging.
-                    return jnp.where(total_w > 0, out, p)
+                    return jnp.where(total_w > 0, bcast_global(gl + md, p), p)
 
                 params = jax.tree.map(q_avg, g, mean_delta, params)
             elif robust:
@@ -466,10 +466,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                         for i in range(len(leaves))]
                     glob = jax.tree.unflatten(
                         jax.tree.structure(gathered), flat_leaves)
-                    params = jax.tree.map(
-                        lambda gl, p: jnp.broadcast_to(
-                            gl[None], p.shape).astype(p.dtype),
-                        glob, agg_params)
+                    params = jax.tree.map(bcast_global, glob, agg_params)
                 elif robust_aggregation == "krum":
                     # Blanchard et al. 2017: score each client by the sum
                     # of squared distances to its C - f - 2 nearest peers;
@@ -494,10 +491,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     winner = jnp.argmin(scores)
 
                     def select_winner(g, p):
-                        glob = jax.lax.dynamic_index_in_dim(
-                            g, winner, keepdims=False)
-                        return jnp.broadcast_to(glob[None],
-                                                p.shape).astype(p.dtype)
+                        return bcast_global(jax.lax.dynamic_index_in_dim(
+                            g, winner, keepdims=False), p)
 
                     params = jax.tree.map(select_winner, gathered,
                                           agg_params)
@@ -512,8 +507,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                             if k_trim:
                                 srt = srt[k_trim:num_clients - k_trim]
                             glob = srt.mean(axis=0)
-                        return jnp.broadcast_to(glob[None],
-                                                p.shape).astype(p.dtype)
+                        return bcast_global(glob, p)
 
                     params = jax.tree.map(ragg, agg_params)
             else:
@@ -526,10 +520,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     local = jnp.tensordot(w.astype(jnp.float32),
                                           p.astype(jnp.float32), axes=1)
                     glob = all_reduce(local) / jnp.maximum(total_w, 1.0)
-                    out = jnp.broadcast_to(glob[None],
-                                           p.shape).astype(p.dtype)
                     # Zero participants (under sampling): skip averaging.
-                    return jnp.where(total_w > 0, out, p)
+                    return jnp.where(total_w > 0, bcast_global(glob, p), p)
 
                 params = jax.tree.map(avg, agg_params)
             pooled_conf = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)

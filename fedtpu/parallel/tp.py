@@ -38,7 +38,7 @@ from fedtpu.ops.server_opt import (ServerOptimizer, clip_by_global_norm,
                                    identity_server_optimizer)
 from fedtpu.parallel.mesh import CLIENTS_AXIS, trim_to_divisor
 from fedtpu.parallel.round import (_DP_NOISE_STREAM, assemble_metrics,
-                                   client_init_keys)
+                                   bcast_global, client_init_keys)
 from fedtpu.training.client import (make_local_eval_step,
                                     make_local_train_step)
 
@@ -262,18 +262,15 @@ def build_round_fn_2d(mesh: Mesh, apply_fn: Callable,
                     sstate, {k: sspecs for k in sstate})
                 g = jax.tree.map(lambda s: s[0], start)  # slots identical
                 params = jax.tree.map(
-                    lambda gl, st, p: jnp.broadcast_to(
-                        (gl + st)[None], p.shape).astype(p.dtype),
+                    lambda gl, st, p: bcast_global(gl + st, p),
                     g, step, params)
             else:
                 avg = jax.tree.map(wmean, params)
                 # Zero total weight (every shard empty): keep params
                 # unchanged, matching the 1-D engine's guard.
                 params = jax.tree.map(
-                    lambda a, p: jnp.where(
-                        tw_raw > 0,
-                        jnp.broadcast_to(a[None],
-                                         p.shape).astype(p.dtype), p),
+                    lambda a, p: jnp.where(tw_raw > 0, bcast_global(a, p),
+                                           p),
                     avg, params)
             # Keep the broadcast result on the declared 2-D layout rather
             # than letting GSPMD pick (e.g. full replication).
