@@ -498,6 +498,14 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                         for k in METRIC_NAMES:
                             test_hist[k].append(float(tm[k]))
 
+            # Checkpoint label semantics under chunking: a checkpoint due
+            # mid-chunk is saved once at the chunk boundary, labeled with —
+            # and containing — the CHUNK-END round `rnd` (states interior to
+            # a scanned chunk never exist on the host). With rounds_per_step
+            # R and checkpoint_every not a multiple of R, on-disk
+            # `round_NNNN` labels therefore land on chunk ends rather than
+            # on the exact due rounds; resume is consistent (label == state
+            # == resume point), just coarser than the R=1 cadence.
             if ckpt_every and cfg.run.checkpoint_dir and any(
                     (rnd - j) % ckpt_every == 0 for j in range(take)):
                 save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd)

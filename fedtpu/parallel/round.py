@@ -173,11 +173,13 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     noise of std ``dp_noise_multiplier * dp_clip_norm / denominator``
     (DP-FedAvg central DP). The denominator is the realized participant
     weight at full participation; under client sampling it is the FIXED
-    public ``participation_rate * num_clients`` (and uniform weighting is
-    required) so sigma is not data-dependent — a zero-participant round then
-    still releases noise, which is the mechanism, not a bug. Under data-size
-    weighting the noise scale is heuristic; use ``weighting='uniform'`` for
-    standard sensitivity accounting. DP with no explicit server optimizer
+    public ``participation_rate * num_clients`` so sigma is not
+    data-dependent — a zero-participant round then still releases noise,
+    which is the mechanism, not a bug. DP noise requires
+    ``weighting='uniform'`` (enforced): the sensitivity bound
+    clip/denominator must be client-agnostic, and data-size weighting would
+    silently deflate the effective noise multiplier to ~z/n_i for a client
+    with n_i samples. DP with no explicit server optimizer
     applies the pure
     averaging rule (fedavgm, momentum 0, lr 1 — exactly FedAvg on clipped,
     noised deltas). State must come from ``init_federated_state`` with the
@@ -242,6 +244,16 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
         raise ValueError("DP with partial participation requires "
                          "weighting='uniform' (fixed public denominator "
                          "q*C for the sensitivity accounting)")
+    if dp_noise_multiplier > 0 and weighting != "uniform":
+        # The noise std z*clip/denominator assumes every client's
+        # contribution to the weighted mean is bounded by clip/denominator.
+        # Under data_size weighting a client with n_i samples contributes up
+        # to n_i*clip/denominator — the effective noise multiplier silently
+        # becomes ~z/n_i, far below the requested privacy level.
+        raise ValueError("DP noise requires weighting='uniform': the "
+                         "per-client sensitivity bound (clip/denominator) "
+                         "must be client-agnostic for the noise calibration "
+                         "to deliver the requested privacy level")
     if compress not in ("none", "int8"):
         raise ValueError(f"unknown compress mode {compress!r}; "
                          "available: 'none', 'int8'")
@@ -555,6 +567,15 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 "delta aggregation (server_opt / DP) needs state from "
                 "init_federated_state(..., server_opt=...) — "
                 "'server_opt_state' missing")
+        if not delta_path and "server_opt_state" in state:
+            # Symmetric to the check above: a state built WITH server_opt
+            # stepped by a round_fn built WITHOUT it would silently fall
+            # back to parameter averaging and drop the server momentum.
+            raise ValueError(
+                "state holds 'server_opt_state' (built with server_opt=...) "
+                "but this round_fn was built without server_opt / DP — the "
+                "server momentum would be silently dropped; build the "
+                "round_fn with the same server_opt")
         if compress != "none" and "shared_start" not in state:
             raise ValueError(
                 "compressed aggregation reconstructs the global as "

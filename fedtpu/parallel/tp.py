@@ -25,6 +25,7 @@ engine); selected via ``RunConfig.model_parallel > 1``.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable
 
 import jax
@@ -195,7 +196,12 @@ def build_round_fn_2d(mesh: Mesh, apply_fn: Callable,
     delta and server state are ordinary clients-free tensors; GSPMD
     replicates/shards them (server state lays out model-sharded like the
     params it mirrors). No client sampling here, so the DP denominator is
-    always the realized participant weight."""
+    always the realized participant weight.
+
+    The returned ``round_step`` DONATES the input state (matching the 1-D
+    engine): always rebind ``state = round_step(state, batch)``; to step one
+    state down two different round functions, clone it first (see
+    fedtpu.utils.trees)."""
     local_train = make_local_train_step(apply_fn, tx, local_steps=local_steps,
                                         prox_mu=prox_mu)
     local_eval = make_local_eval_step(apply_fn, num_classes)
@@ -205,6 +211,15 @@ def build_round_fn_2d(mesh: Mesh, apply_fn: Callable,
     if dp_noise_multiplier > 0 and dp_clip_norm <= 0:
         raise ValueError("dp_noise_multiplier requires dp_clip_norm > 0 "
                          "(noise std is noise_multiplier * clip / weight)")
+    if dp_noise_multiplier > 0 and weighting != "uniform":
+        # Mirrors the 1-D engine: the noise std z*clip/total_weight assumes
+        # a client-agnostic sensitivity bound clip/total_weight; data_size
+        # weighting breaks that (a client contributes up to
+        # n_i*clip/total_weight), silently deflating the privacy level.
+        raise ValueError("DP noise requires weighting='uniform': the "
+                         "per-client sensitivity bound (clip/denominator) "
+                         "must be client-agnostic for the noise calibration "
+                         "to deliver the requested privacy level")
     if delta_path and server_opt is None:
         server_opt = identity_server_optimizer()
 
@@ -213,13 +228,24 @@ def build_round_fn_2d(mesh: Mesh, apply_fn: Callable,
             lambda p, s: jax.lax.with_sharding_constraint(
                 p, NamedSharding(mesh, s)), params, specs)
 
-    @jax.jit
+    # Donate the state, matching the 1-D engine's round_step: callers rebind
+    # `state = round_step(state, ...)`, and this engine explicitly targets
+    # models too large for one core — without donation, peak device memory
+    # doubles for the per-client params/opt-state. CPU ignores donation with
+    # a warning; TPU honors it.
+    @partial(jax.jit, donate_argnums=(0,))
     def round_step(state, batch):
         if delta_path and "server_opt_state" not in state:
             raise ValueError(
                 "delta aggregation (server_opt / DP) needs state from "
                 "init_federated_state_2d(..., server_opt=...) — "
                 "'server_opt_state' missing")
+        if not delta_path and "server_opt_state" in state:
+            raise ValueError(
+                "state holds 'server_opt_state' (built with server_opt=...) "
+                "but this round_fn was built without server_opt / DP — the "
+                "server momentum would be silently dropped; build the "
+                "round_fn with the same server_opt")
         x, y, mask = batch["x"], batch["y"], batch["mask"]
         specs = tp_specs(state["params"])
         sspecs = jax.tree.map(drop_client_axis, specs)

@@ -164,6 +164,57 @@ def test_missing_server_state_is_a_clear_error():
         step(state, batch)
 
 
+def test_stale_server_state_is_a_clear_error():
+    # The opposite mismatch: a state built WITH server_opt stepped by a
+    # round_fn built WITHOUT it must raise, not silently drop the server
+    # momentum and fall back to parameter averaging (ADVICE r1).
+    import pytest
+    server = make_server_optimizer("fedadam")
+    state, batch, _ = _setup(server=server)        # state WITH server init
+    _, _, step = _setup(server=None)
+    with pytest.raises(ValueError, match="silently dropped"):
+        step(state, batch)
+
+
+def test_stale_server_state_is_a_clear_error_2d():
+    import pytest
+    from fedtpu.parallel import tp
+    server = make_server_optimizer("fedadam")
+    mesh = tp.make_mesh_2d(2, num_clients=4)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig())
+    state = tp.init_federated_state_2d(jax.random.key(0), mesh, 4, init_fn,
+                                       tx, server_opt=server)
+    x, y = synthetic_income_like(64, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=4, shuffle=False))
+    shard = tp.batch_sharding_2d(mesh)
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    step = tp.build_round_fn_2d(mesh, apply_fn, tx, 2)   # no server_opt
+    with pytest.raises(ValueError, match="silently dropped"):
+        step(state, batch)
+
+
+def test_dp_noise_rejects_data_size_weighting_both_engines():
+    # DP noise std is calibrated to a client-agnostic sensitivity bound;
+    # data_size weighting would silently deflate the privacy level
+    # (ADVICE r1, severity medium) — both engines must fail fast.
+    import pytest
+    from fedtpu.parallel import tp
+    ident = make_server_optimizer("fedavgm", learning_rate=1.0, momentum=0.0)
+    with pytest.raises(ValueError, match="uniform"):
+        _setup(server=ident, dp_clip_norm=1.0, dp_noise_multiplier=0.5,
+               weighting="data_size")
+    mesh = tp.make_mesh_2d(2, num_clients=4)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig())
+    with pytest.raises(ValueError, match="uniform"):
+        tp.build_round_fn_2d(mesh, apply_fn, tx, 2, weighting="data_size",
+                             dp_clip_norm=1.0, dp_noise_multiplier=0.5)
+
+
 def test_delta_path_rejects_ring_aggregation():
     import pytest
     with pytest.raises(ValueError, match="psum"):
@@ -203,7 +254,8 @@ def test_dp_noise_is_seed_deterministic():
     ident = make_server_optimizer("fedavgm", learning_rate=1.0, momentum=0.0)
     runs = {}
     for seed in (0, 0, 7):
-        state, batch, step = _setup(server=ident, dp_clip_norm=0.1,
+        state, batch, step = _setup(server=ident, weighting="uniform",
+                                    dp_clip_norm=0.1,
                                     dp_noise_multiplier=0.5, dp_seed=seed)
         state, _ = step(state, batch)
         runs.setdefault(seed, []).append(_params0(state))
