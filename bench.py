@@ -7,16 +7,31 @@ Prints ONE JSON line:
 Ours: the fedtpu compiled round (local full-batch Adam step + in-graph
 weighted FedAvg + in-graph metrics) on the default JAX backend (the TPU chip
 when present), one ('clients',) mesh over the visible devices, 8 clients.
+The headline value is measured at rounds_per_step=100 (the production
+throughput knob: 100 rounds scanned per compiled program, early-stop checks
+at chunk boundaries); the full rps sweep is reported on stderr.
 
-Baseline: the reference publishes no numbers (BASELINE.md), so the baseline is
-MEASURED here as a faithful single-host simulation of the reference's per-round
-work under ``mpirun -np 8`` (FL_CustomMLP...:63-120): per rank a full-batch
-torch forward/backward/Adam step + argmax eval on its shard, then the rank-0
-aggregation path — pickle every rank's weight dict (comm.gather), numpy
-weighted average, pickle the global dict back out (comm.bcast), and load into
-each model. Ranks run concurrently under mpirun, so the compute part is
-divided by min(8, cpu_count) (ideal oversubscription); the serialization +
-averaging path is inherently serialized through rank 0 and is not divided.
+TIMING METHODOLOGY (round-2 rewrite — the round-1 numbers were wrong):
+``jax.block_until_ready`` does NOT synchronize on this platform's remote
+('axon') transport — closing a timed window with it measures dispatch rate,
+not compute, which overstated round 1's speedup ~500x (22,260x recorded;
+~44x real). Every timed window here is closed by ``force_fetch`` (a host
+value fetch that provably depends on the full program), and every result
+must pass ``assert_above_flops_floor``: sec/round >= program FLOPs /
+(2 x measured device peak), with peak measured on-device by a
+dispatch-cancelling matmul-chain slope. A floor violation crashes the
+benchmark rather than recording a fantasy number.
+
+Baseline: the reference publishes no numbers (BASELINE.md), so the baseline
+is MEASURED here as a faithful single-host simulation of the reference's
+per-round work under ``mpirun -np 8`` (FL_CustomMLP...:63-120): per rank a
+full-batch torch forward/backward/Adam step + argmax eval on its shard, then
+the rank-0 aggregation path — pickle every rank's weight dict (comm.gather),
+numpy weighted average, pickle the global dict back out (comm.bcast), and
+load into each model. Ranks run concurrently under mpirun, so the compute
+part is divided by min(8, cpu_count) (ideal oversubscription); the
+serialization + averaging path is inherently serialized through rank 0 and
+is not divided.
 """
 
 from __future__ import annotations
@@ -29,13 +44,13 @@ import time
 
 import numpy as np
 
-ROUNDS = 100
-WARMUP = 3
 NUM_CLIENTS = 8
-# Rounds scanned per compiled program (the production throughput knob,
-# RunConfig.rounds_per_step). Dispatch overhead amortizes with the scan
-# depth: ~13 us/round at 10, ~1.1 us/round at 100 (v5e, income MLP).
-ROUNDS_PER_STEP = 100
+# rounds_per_step values swept; the headline is HEADLINE_RPS. Dispatch
+# overhead (~60-100 ms/call through the tunnel) amortizes with scan depth,
+# so sec/round falls steeply with rps and flattens at the marginal on-chip
+# cost per round.
+RPS_SWEEP = (1, 10, 100, 1000)
+HEADLINE_RPS = 100
 
 
 def _dataset():
@@ -56,6 +71,9 @@ def bench_fedtpu(ds) -> dict:
     from fedtpu.ops import build_optimizer
     from fedtpu.parallel import make_mesh, client_sharding
     from fedtpu.parallel.round import build_round_fn, init_federated_state
+    from fedtpu.utils.timing import (assert_above_flops_floor,
+                                     compile_with_flops, force_fetch,
+                                     measured_peak_flops)
 
     mesh = make_mesh(num_clients=NUM_CLIENTS)
     shard = client_sharding(mesh)
@@ -69,26 +87,80 @@ def bench_fedtpu(ds) -> dict:
     init_fn, apply_fn = build_model(ModelConfig(input_dim=ds.input_dim,
                                                 num_classes=ds.num_classes))
     tx = build_optimizer(OptimConfig())
-    state = init_federated_state(jax.random.key(0), mesh, NUM_CLIENTS,
-                                 init_fn, tx)
-    round_step = build_round_fn(mesh, apply_fn, tx, ds.num_classes,
-                                rounds_per_step=ROUNDS_PER_STEP)
 
-    for _ in range(WARMUP):
-        state, metrics = round_step(state, batch)
-    jax.block_until_ready(state["params"])
+    # Device peak for the flops floor, measured at the matmul rate the model
+    # actually gets (XLA default precision; on TPU f32 matmuls ride the MXU
+    # in bf16 passes, so this sits near the bf16 spec peak — a HIGH peak
+    # only loosens the floor, which is the safe direction).
+    dev = mesh.devices.ravel()[0]
+    peak = measured_peak_flops(dtype="float32", device=dev)
 
-    t0 = time.perf_counter()
-    for _ in range(ROUNDS):
-        state, metrics = round_step(state, batch)
-    jax.block_until_ready(state["params"])
-    sec_per_round = (time.perf_counter() - t0) / (ROUNDS * ROUNDS_PER_STEP)
-    return {"sec_per_round": sec_per_round,
-            "rounds_per_step": ROUNDS_PER_STEP,
-            "accuracy": float(np.atleast_1d(
-                np.asarray(metrics["client_mean"]["accuracy"]))[-1]),
+    sweep = {}
+    flops_per_round = None
+    for rps in RPS_SWEEP:
+        state = init_federated_state(jax.random.key(0), mesh, NUM_CLIENTS,
+                                     init_fn, tx)
+        step = build_round_fn(mesh, apply_fn, tx, ds.num_classes,
+                              rounds_per_step=rps)
+        # compile_with_flops raises if XLA cost analysis is unavailable —
+        # no floor, no number. A lax.scan body is counted ONCE regardless
+        # of length, so the scanned program's "flops" IS the per-round cost
+        # (verified: cost(rps=100) == cost(rps=1) on this backend).
+        step, flops_per_round = compile_with_flops(step, state, batch)
+        for _ in range(2):                     # executable warmup
+            state, metrics = step(state, batch)
+        force_fetch(metrics["client_mean"]["accuracy"])
+
+        # PIPELINED throughput: back-to-back calls, one completion-proving
+        # fetch at the end (the fixed-rounds production shape — run N
+        # chunks, read results at the end). Dispatch overlaps compute.
+        n_calls = max(3, min(20, 2000 // rps))
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            state, metrics = step(state, batch)
+        # The timed window is closed by a host value fetch that depends on
+        # the final state of the whole call chain — the only completion
+        # proof on this transport (block_until_ready does not synchronize).
+        acc = force_fetch(metrics["client_mean"]["accuracy"])
+        sec_per_round = (time.perf_counter() - t0) / (n_calls * rps)
+
+        # SYNCHRONOUS latency: fetch the metrics after every call — the
+        # early-stopping production loop's shape (host inspects metrics at
+        # each chunk boundary), paying one dispatch+fetch RTT per chunk.
+        t0 = time.perf_counter()
+        sync_calls = 3
+        for _ in range(sync_calls):
+            state, metrics = step(state, batch)
+            force_fetch(metrics["client_mean"]["accuracy"])
+        sec_sync = (time.perf_counter() - t0) / (sync_calls * rps)
+
+        floor = assert_above_flops_floor(sec_per_round, flops_per_round,
+                                         peak, label=f"rps={rps}")
+        assert_above_flops_floor(sec_sync, flops_per_round, peak,
+                                 label=f"rps={rps} sync")
+        sweep[rps] = {"sec_per_round": sec_per_round,
+                      "sec_per_round_sync": sec_sync,
+                      "rounds_timed": n_calls * rps,
+                      "floor_sec": floor,
+                      "final_accuracy": acc}
+
+    head = sweep[HEADLINE_RPS]
+    # Training must be real: ~2000+ rounds on the income MLP reaches ~0.83
+    # accuracy (round-1 verified trajectory). A dead program would fail here.
+    if head["final_accuracy"] < 0.75:
+        raise RuntimeError(
+            f"benchmark program is not actually training: accuracy "
+            f"{head['final_accuracy']:.3f} after {head['rounds_timed']} "
+            "rounds (expected ~0.83)")
+    return {"sec_per_round": head["sec_per_round"],
+            "sec_per_round_sync": head["sec_per_round_sync"],
+            "rounds_per_step": HEADLINE_RPS,
+            "accuracy": head["final_accuracy"],
             "devices": len(mesh.devices.ravel()),
-            "backend": mesh.devices.ravel()[0].platform}
+            "backend": dev.platform,
+            "peak_flops_measured": peak,
+            "flops_per_round": flops_per_round,
+            "sweep": sweep}
 
 
 def bench_reference_equivalent(ds) -> dict:
@@ -178,8 +250,8 @@ def main():
     base = bench_reference_equivalent(ds)
     result = {
         "metric": "sec_per_round_fedavg8_income_mlp",
-        # 3 significant figures, not fixed decimals — the value sits at
-        # microsecond scale where round(v, 6) would destroy it.
+        # 3 significant figures — the value sits at sub-millisecond scale
+        # where fixed decimals would destroy it.
         "value": float(f"{ours['sec_per_round']:.3g}"),
         "unit": "s",
         "vs_baseline": float(
@@ -187,7 +259,20 @@ def main():
     }
     print(json.dumps(result))
     # Detail lines on stderr so stdout stays one JSON line.
-    print(f"[bench] ours: {ours}", file=sys.stderr)
+    print(f"[bench] headline (rps={HEADLINE_RPS}, pipelined): "
+          f"{ours['sec_per_round']:.3e} s/round "
+          f"(synchronous {ours['sec_per_round_sync']:.3e}), "
+          f"accuracy {ours['accuracy']:.4f}, devices {ours['devices']}, "
+          f"backend {ours['backend']}, measured peak "
+          f"{ours['peak_flops_measured'] / 1e12:.1f} TFLOP/s, "
+          f"{ours['flops_per_round']:.2e} FLOPs/round",
+          file=sys.stderr)
+    for rps, row in ours["sweep"].items():
+        print(f"[bench] rps={rps:>4}: pipelined "
+              f"{row['sec_per_round']:.3e} s/round, sync "
+              f"{row['sec_per_round_sync']:.3e} s/round "
+              f"(floor {row['floor_sec']:.3e}, "
+              f"{row['rounds_timed']} rounds timed)", file=sys.stderr)
     print(f"[bench] baseline(measured reference-equivalent): {base}",
           file=sys.stderr)
 

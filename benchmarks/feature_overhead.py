@@ -47,7 +47,8 @@ MODES = {
 }
 
 
-def bench_mode(name: str, kw: dict, ds, reps: int, rps: int) -> dict:
+def bench_mode(name: str, kw: dict, ds, reps: int, rps: int,
+               peak_flops: float) -> dict:
     kw = dict(kw)
     mesh = make_mesh(num_clients=NUM_CLIENTS)
     shard = client_sharding(mesh)
@@ -74,14 +75,22 @@ def bench_mode(name: str, kw: dict, ds, reps: int, rps: int) -> dict:
     step = build_round_fn(mesh, apply_fn, tx, ds.num_classes,
                           rounds_per_step=rps, server_opt=server, **kw)
 
+    # Fetch-forced timing + flops floor — see fedtpu.utils.timing docstring
+    # for the methodology (round-1 postmortem).
+    from fedtpu.utils.timing import (assert_above_flops_floor,
+                                     compile_with_flops, force_fetch)
+
+    step, flops_per_round = compile_with_flops(step, state, batch)
+
     for _ in range(3):
         state, m = step(state, batch)
-    jax.block_until_ready(state["params"])
+    force_fetch(m["client_mean"]["accuracy"])
     t0 = time.perf_counter()
     for _ in range(reps):
         state, m = step(state, batch)
-    jax.block_until_ready(state["params"])
+    force_fetch(m["client_mean"]["accuracy"])
     sec = (time.perf_counter() - t0) / (reps * rps)
+    assert_above_flops_floor(sec, flops_per_round, peak_flops, label=name)
     return {"mode": name, "sec_per_round": float(f"{sec:.4g}"),
             "rounds_per_step": rps,
             "backend": mesh.devices.ravel()[0].platform}
@@ -93,10 +102,13 @@ def main():
     ap.add_argument("--rounds-per-step", type=int, default=100)
     args = ap.parse_args()
 
+    from fedtpu.utils.timing import measured_peak_flops
+
+    peak = measured_peak_flops(dtype="float32")
     ds = load_tabular_dataset(DataConfig(csv_path=default_income_csv()))
     base = None
     for name, kw in MODES.items():
-        row = bench_mode(name, kw, ds, args.reps, args.rounds_per_step)
+        row = bench_mode(name, kw, ds, args.reps, args.rounds_per_step, peak)
         if name == "mean":
             base = row["sec_per_round"]
         row["vs_mean"] = float(f"{row['sec_per_round'] / base:.3g}")

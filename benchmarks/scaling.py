@@ -32,7 +32,8 @@ from fedtpu.parallel.round import build_round_fn, init_federated_state
 
 
 def bench_config(name: str, ds, model_cfg: ModelConfig, num_clients: int,
-                 rounds: int, rounds_per_step: int) -> dict:
+                 rounds: int, rounds_per_step: int,
+                 peak_flops: float) -> dict:
     mesh = make_mesh(num_clients=num_clients)
     shard = client_sharding(mesh)
     packed = pack_clients(ds.x_train, ds.y_train,
@@ -46,15 +47,23 @@ def bench_config(name: str, ds, model_cfg: ModelConfig, num_clients: int,
     step = build_round_fn(mesh, apply_fn, tx, ds.num_classes,
                           rounds_per_step=rounds_per_step)
 
-    for _ in range(3):                      # compile + executable warmup
+    # Fetch-forced timing + flops floor — see fedtpu.utils.timing docstring
+    # for the methodology (round-1 postmortem).
+    from fedtpu.utils.timing import (assert_above_flops_floor,
+                                     compile_with_flops, force_fetch)
+
+    step, flops_per_round = compile_with_flops(step, state, batch)
+
+    for _ in range(3):                      # executable warmup
         state, m = step(state, batch)
-    jax.block_until_ready(state["params"])
+    force_fetch(m["client_mean"]["accuracy"])
     t0 = time.perf_counter()
     iters = max(3, rounds // rounds_per_step)
     for _ in range(iters):
         state, m = step(state, batch)
-    jax.block_until_ready(state["params"])
+    force_fetch(m["client_mean"]["accuracy"])
     sec = (time.perf_counter() - t0) / (iters * rounds_per_step)
+    assert_above_flops_floor(sec, flops_per_round, peak_flops, label=name)
     return {
         "config": name, "num_clients": num_clients,
         "sec_per_round": round(sec, 9),
@@ -72,12 +81,16 @@ def main():
     ap.add_argument("--skip-cifar", action="store_true")
     args = ap.parse_args()
 
+    from fedtpu.utils.timing import measured_peak_flops
+
+    peak = measured_peak_flops(dtype="float32")
     income = load_tabular_dataset(DataConfig(csv_path=default_income_csv()))
     mlp = ModelConfig(input_dim=income.input_dim,
                       num_classes=income.num_classes)
     for c in (1, 8, 32):
         print(json.dumps(bench_config(f"income-mlp-{c}", income, mlp, c,
-                                      args.rounds, args.rounds_per_step)),
+                                      args.rounds, args.rounds_per_step,
+                                      peak)),
               flush=True)
 
     if not args.skip_cifar:
@@ -85,7 +98,8 @@ def main():
         conv = ModelConfig(kind="convnet", num_classes=10,
                            hidden_sizes=(256,), compute_dtype="bfloat16")
         print(json.dumps(bench_config("cifar10-convnet-32", cifar, conv, 32,
-                                      args.rounds, args.rounds_per_step)),
+                                      args.rounds, args.rounds_per_step,
+                                      peak)),
               flush=True)
 
 

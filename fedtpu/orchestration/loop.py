@@ -50,7 +50,7 @@ from fedtpu.orchestration.checkpoint import save_checkpoint
 from fedtpu.parallel.mesh import make_mesh, client_sharding
 from fedtpu.parallel.round import (build_round_fn, build_eval_fn,
                                    init_federated_state, global_params)
-from fedtpu.utils.timing import Timer
+from fedtpu.utils.timing import Timer, force_fetch
 from fedtpu.utils.trees import to_numpy
 
 
@@ -396,6 +396,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             take = min(chunk, cfg.fed.rounds - rnd)
             state, metrics = get_step(take)(state, batch)
             per_round = _unstack_metrics(metrics, take)
+            # Completion proof BEFORE reading the lap time: on the tunneled
+            # axon transport, dispatch returns before the chunk has executed
+            # (block_until_ready does not synchronize there), so the lap
+            # must be closed by a host value fetch that depends on the
+            # whole chunk or ms/round would measure dispatch rate.
+            force_fetch(metrics["client_mean"]["accuracy"])
             dt = timer.lap() / take
 
             for j, m in enumerate(per_round):
@@ -512,7 +518,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
 
     finally:
         if cfg.run.profile_dir:
-            jax.block_until_ready(state["params"])
+            # Completion proof before finalizing the trace —
+            # block_until_ready does not synchronize on the axon transport,
+            # and a trace stopped early would miss the device activity it
+            # exists to capture.
+            force_fetch(state["params"])
             jax.profiler.stop_trace()
         if jsonl is not None:
             jsonl.close()

@@ -1,5 +1,15 @@
 """Wall-clock timing — the observability the reference lacks entirely
-(SURVEY.md §5: no timers, no profiler; ``print(flush=True)`` only)."""
+(SURVEY.md §5: no timers, no profiler; ``print(flush=True)`` only).
+
+Fetch-forced timing (``force_fetch`` / ``measured_peak_flops`` /
+``assert_above_flops_floor``): on this platform's remote ('axon') TPU
+transport, ``jax.block_until_ready`` can return before the enqueued compute
+has actually executed, so wall-clock timing closed by it measures DISPATCH
+rate, not compute (round-1 postmortem: a 22,260x headline that was really
+~44x). A host value fetch cannot lie — transferring a value that depends on
+the full program forces real completion. Every benchmark in this repo must
+close its timed window with ``force_fetch`` and guard the result with
+``assert_above_flops_floor``."""
 
 from __future__ import annotations
 
@@ -31,3 +41,134 @@ class Timer:
     def mean(self, skip_first: int = 0) -> float:
         laps = self.laps[skip_first:] or self.laps
         return sum(laps) / max(len(laps), 1)
+
+
+def force_fetch(tree) -> float:
+    """Fetch one host value that depends on ``tree`` — the only completion
+    proof this platform offers (see module docstring). The reduction to a
+    scalar happens ON DEVICE so only ~4 bytes cross the (slow, tunneled)
+    host link — fetching a whole array would add seconds of transfer to the
+    timed window. Returns the fetched scalar so callers can sanity-check
+    it."""
+    import jax
+    import numpy as np
+
+    leaves = [l for l in jax.tree.leaves(tree) if isinstance(l, jax.Array)]
+    if not leaves:
+        # A host-only tree proves nothing about device completion — a timed
+        # window "closed" here would silently measure dispatch rate again.
+        # Refuse rather than look like success.
+        raise TypeError(
+            "force_fetch: no device-backed (jax.Array) leaf in the tree — "
+            "fetching host values proves nothing about device completion")
+    leaf = leaves[-1]
+    if getattr(leaf, "ndim", 0):
+        leaf = leaf.reshape(-1)[-1]        # device-side slice, scalar out
+    return float(np.asarray(leaf))
+
+
+def compile_with_flops(step, *args):
+    """AOT-compile a jitted program once; return ``(compiled, flops)``.
+
+    The single shared path for benchmark scripts: the returned executable is
+    what the timed loop must call (the AOT path does not populate jax.jit's
+    dispatch cache, so lowering for cost analysis and then calling the
+    jitted function would compile the same program twice — expensive through
+    the tunneled backend). ``flops`` is the program's XLA cost analysis;
+    note a ``lax.scan`` body is counted ONCE regardless of length, so for a
+    scanned multi-round program this is the PER-ROUND cost. Raises when cost
+    analysis is unavailable: a benchmark that cannot check its flops floor
+    must not record a number at all."""
+    compiled = step.lower(*args).compile()
+    flops = float((compiled.cost_analysis() or {}).get("flops", 0.0))
+    if flops <= 0:
+        raise RuntimeError(
+            "XLA cost_analysis unavailable for this program; the flops "
+            "floor cannot be checked — refusing to record an unguarded "
+            "perf number")
+    return compiled, flops
+
+
+def measured_peak_flops(dtype="float32", n: int | None = None,
+                        chains=None, device=None) -> float:
+    """Achieved FLOP/s on an n x n matmul chain, fetch-forced.
+
+    Times two scanned programs of ``chains[0]`` and ``chains[1]`` dependent
+    matmuls and uses the SLOPE (t2-t1)/(k2-k1): fixed per-call costs —
+    dispatch RTT over the tunnel (~100 ms on this box) and the scalar fetch
+    — cancel exactly, so the result is the marginal per-matmul rate. The
+    chain lengths are far apart because the fixed cost dwarfs short chains
+    (measured here: 191 TFLOP/s bf16 from a (80,256) slope ≈ the v5e spec
+    peak, vs 571 "TFLOP/s" from a noise-dominated (16,80) slope). The chain
+    returns an on-device scalar so the fetch moves ~4 bytes.
+
+    This feeds the DENOMINATOR of the flops-floor check, so accuracy
+    matters in one direction: an UNDERestimated peak inflates the floor and
+    could fail an honest measurement. The slope method plus large-n MXU
+    -friendly shapes keeps the estimate near true peak; the floor's 2x
+    headroom absorbs the rest."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if n is None or chains is None:
+        platform = (device.platform if device is not None
+                    else jax.devices()[0].platform)
+        if platform == "cpu":
+            # The accelerator-scale default (~1.8e14 FLOPs) would run for
+            # hours on the 1-core CPU verification box; a small probe keeps
+            # the floor meaningful (CPU peak ~ GFLOP/s) and the script fast.
+            n, chains = (n or 512), (chains or (4, 20))
+        else:
+            n, chains = (n or 4096), (chains or (32, 288))
+
+    a = jnp.asarray(np.random.default_rng(0).standard_normal((n, n)),
+                    dtype=dtype)
+    if device is not None:
+        a = jax.device_put(a, device)
+
+    def make(k):
+        @jax.jit
+        def chained(x):
+            def body(y, _):
+                # Rescale so the chain neither overflows nor denormals out.
+                y = y @ x
+                return y / jnp.sqrt(jnp.float32(n)).astype(y.dtype), None
+            y, _ = jax.lax.scan(body, x, length=k)
+            return y.sum()                 # scalar out: 4-byte fetch
+        return chained
+
+    times = []
+    for k in chains:
+        fn = make(k)
+        force_fetch(fn(a))                 # compile + warmup
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            force_fetch(fn(a))
+            best = min(best, time.perf_counter() - t0)
+        times.append(best)
+    dt = times[1] - times[0]
+    if dt <= 0:                            # noise swamped the slope; fall
+        dt = times[1]                      # back to the long chain alone
+        return 2.0 * n * n * n * chains[1] / dt
+    return 2.0 * n * n * n * (chains[1] - chains[0]) / dt
+
+
+def assert_above_flops_floor(sec_per_round: float, flops_per_round: float,
+                             peak_flops: float, label: str = "") -> float:
+    """Physics guard for benchmark numbers: no program can run its FLOPs
+    faster than 2x the measured peak (the 2x absorbs peak-measurement noise
+    and mixed-precision ambiguity). A violation means the timing methodology
+    is broken (round 1: async dispatch measured instead of compute) and MUST
+    fail loudly rather than record a fantasy number. Returns the floor."""
+    floor = flops_per_round / (2.0 * peak_flops)
+    if sec_per_round < floor:
+        raise RuntimeError(
+            f"timing methodology broken{' (' + label + ')' if label else ''}:"
+            f" measured {sec_per_round:.3e} s/round but the program costs "
+            f"{flops_per_round:.3e} FLOPs and the device measures "
+            f"{peak_flops:.3e} FLOP/s peak — physical floor "
+            f"{floor:.3e} s/round. The timed window is not capturing "
+            "execution (dispatch-rate artifact); close it with force_fetch.")
+    return floor
