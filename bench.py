@@ -73,7 +73,7 @@ def bench_fedtpu(ds) -> dict:
     from fedtpu.parallel.round import build_round_fn, init_federated_state
     from fedtpu.utils.timing import (assert_above_flops_floor,
                                      compile_with_flops, force_fetch,
-                                     measured_peak_flops)
+                                     measured_peak_flops, timed_rounds)
 
     mesh = make_mesh(num_clients=NUM_CLIENTS)
     shard = client_sharding(mesh)
@@ -107,22 +107,17 @@ def bench_fedtpu(ds) -> dict:
         # of length, so the scanned program's "flops" IS the per-round cost
         # (verified: cost(rps=100) == cost(rps=1) on this backend).
         step, flops_per_round = compile_with_flops(step, state, batch)
-        for _ in range(2):                     # executable warmup
-            state, metrics = step(state, batch)
-        force_fetch(metrics["client_mean"]["accuracy"])
 
         # PIPELINED throughput: back-to-back calls, one completion-proving
         # fetch at the end (the fixed-rounds production shape — run N
         # chunks, read results at the end). Dispatch overlaps compute.
+        # timed_rounds is the mandatory harness: fetch-forced window +
+        # flops-floor check.
         n_calls = max(3, min(20, 2000 // rps))
-        t0 = time.perf_counter()
-        for _ in range(n_calls):
-            state, metrics = step(state, batch)
-        # The timed window is closed by a host value fetch that depends on
-        # the final state of the whole call chain — the only completion
-        # proof on this transport (block_until_ready does not synchronize).
-        acc = force_fetch(metrics["client_mean"]["accuracy"])
-        sec_per_round = (time.perf_counter() - t0) / (n_calls * rps)
+        sec_per_round, state, metrics = timed_rounds(
+            step, state, batch, n_calls, rps, peak, flops_per_round,
+            label=f"rps={rps}")
+        acc = float(np.asarray(metrics["client_mean"]["accuracy"]).ravel()[-1])
 
         # SYNCHRONOUS latency: fetch the metrics after every call — the
         # early-stopping production loop's shape (host inspects metrics at

@@ -77,20 +77,11 @@ def bench_mode(name: str, kw: dict, ds, reps: int, rps: int,
 
     # Fetch-forced timing + flops floor — see fedtpu.utils.timing docstring
     # for the methodology (round-1 postmortem).
-    from fedtpu.utils.timing import (assert_above_flops_floor,
-                                     compile_with_flops, force_fetch)
+    from fedtpu.utils.timing import compile_with_flops, timed_rounds
 
     step, flops_per_round = compile_with_flops(step, state, batch)
-
-    for _ in range(3):
-        state, m = step(state, batch)
-    force_fetch(m["client_mean"]["accuracy"])
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        state, m = step(state, batch)
-    force_fetch(m["client_mean"]["accuracy"])
-    sec = (time.perf_counter() - t0) / (reps * rps)
-    assert_above_flops_floor(sec, flops_per_round, peak_flops, label=name)
+    sec, state, m = timed_rounds(step, state, batch, reps, rps,
+                                 peak_flops, flops_per_round, label=name)
     return {"mode": name, "sec_per_round": float(f"{sec:.4g}"),
             "rounds_per_step": rps,
             "backend": mesh.devices.ravel()[0].platform}

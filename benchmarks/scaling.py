@@ -49,21 +49,12 @@ def bench_config(name: str, ds, model_cfg: ModelConfig, num_clients: int,
 
     # Fetch-forced timing + flops floor — see fedtpu.utils.timing docstring
     # for the methodology (round-1 postmortem).
-    from fedtpu.utils.timing import (assert_above_flops_floor,
-                                     compile_with_flops, force_fetch)
+    from fedtpu.utils.timing import compile_with_flops, timed_rounds
 
     step, flops_per_round = compile_with_flops(step, state, batch)
-
-    for _ in range(3):                      # executable warmup
-        state, m = step(state, batch)
-    force_fetch(m["client_mean"]["accuracy"])
-    t0 = time.perf_counter()
     iters = max(3, rounds // rounds_per_step)
-    for _ in range(iters):
-        state, m = step(state, batch)
-    force_fetch(m["client_mean"]["accuracy"])
-    sec = (time.perf_counter() - t0) / (iters * rounds_per_step)
-    assert_above_flops_floor(sec, flops_per_round, peak_flops, label=name)
+    sec, state, m = timed_rounds(step, state, batch, iters, rounds_per_step,
+                                 peak_flops, flops_per_round, label=name)
     return {
         "config": name, "num_clients": num_clients,
         "sec_per_round": round(sec, 9),

@@ -521,8 +521,14 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # Completion proof before finalizing the trace —
             # block_until_ready does not synchronize on the axon transport,
             # and a trace stopped early would miss the device activity it
-            # exists to capture.
-            force_fetch(state["params"])
+            # exists to capture. Best-effort: on the mid-run-failure path
+            # this finally exists for, the donated state buffers may
+            # already be deleted, and a raise here would mask the original
+            # error and skip stop_trace/close below.
+            try:
+                force_fetch(state["params"])
+            except Exception:
+                pass
             jax.profiler.stop_trace()
         if jsonl is not None:
             jsonl.close()
