@@ -251,6 +251,11 @@ def main(argv=None) -> int:
                          help="write the full per-config result table here, "
                               "one JSON line per config (the reference only "
                               "prints the best, hyperparameters_tuning.py:126)")
+    sweep_p.add_argument("--save-weights", default=None, metavar="NPZ",
+                         help="persist the winning config's post-averaging "
+                              "weights + hyperparameters + metrics as an "
+                              ".npz (the reference only prints them, "
+                              "hyperparameters_tuning.py:130-132)")
 
     parity_p = sub.add_parser("parity",
                               help="sklearn warm-start limitation demo")
@@ -275,10 +280,21 @@ def main(argv=None) -> int:
                                 resume=args.resume)
         summary = result.summary()
     elif args.cmd == "sweep":
-        from fedtpu.sweep.grid import run_grid_search
-        # Open the table file BEFORE the (minutes-long) sweep so a bad path
-        # fails fast instead of discarding the finished run's output.
+        from fedtpu.sweep.grid import run_grid_search, save_best_weights
+        # Fail fast on BOTH output paths before the (minutes-long) sweep —
+        # and probe the weights path before truncating the table file, so a
+        # typo'd weights path can't destroy a previous run's table.
+        if args.save_weights:
+            open(args.save_weights, "ab").close()
         table_f = open(args.table_jsonl, "w") if args.table_jsonl else None
+        # --hidden-sizes / --learning-rate narrow the sweep to that single
+        # architecture / learning rate (the default is the reference's full
+        # 10x9 grid) — the flags must never be silently ignored.
+        grid_kw = {}
+        if args.hidden_sizes is not None:
+            grid_kw["hidden_grid"] = (tuple(args.hidden_sizes),)
+        if args.learning_rate is not None:
+            grid_kw["lr_grid"] = (args.learning_rate,)
         try:
             summary = run_grid_search(
                 cfg, vmap_lr=not args.no_vmap_lr,
@@ -286,10 +302,16 @@ def main(argv=None) -> int:
                 # 400 (MLPClassifier max_iter, hyperparameters_tuning.py:90).
                 **({"local_steps": args.local_steps}
                    if args.local_steps is not None else {}),
+                **grid_kw,
+                keep_weights=bool(args.save_weights),
                 verbose=not args.quiet)
             if table_f is not None:
                 for row in summary["table"]:
                     table_f.write(json.dumps(row, default=float) + "\n")
+            if args.save_weights:
+                save_best_weights(args.save_weights, summary)
+                # Keep the JSON summary line serializable.
+                summary.pop("weights", None)
         finally:
             if table_f is not None:
                 table_f.close()

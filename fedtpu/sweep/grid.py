@@ -103,10 +103,16 @@ def _build_sweep_fn(mesh, num_classes: int, local_steps: int, optim_cfg):
 def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     hidden_grid=None, lr_grid=None,
                     local_steps: int = 400, vmap_lr: bool = True,
+                    keep_weights: bool = False,
                     verbose: bool = True) -> dict:
     """Run the 90-config federated grid; returns the best-config summary
     (the reference's :126-132 printout, as data). ``hidden_grid``/``lr_grid``
-    default to the module-level reference grids, resolved at call time."""
+    default to the module-level reference grids, resolved at call time.
+
+    ``keep_weights=True`` retains the winning config's post-averaging
+    weight pytree under ``best["weights"]`` (numpy leaves) — the artifact
+    the reference prints to stdout at hyperparameters_tuning.py:130-132
+    (tracked at :115-119); pass it to ``save_best_weights`` to persist."""
     hidden_grid = HIDDEN_GRID if hidden_grid is None else hidden_grid
     lr_grid = LR_GRID if lr_grid is None else lr_grid
     ds = dataset or load_dataset(cfg.data)
@@ -172,8 +178,51 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     if verbose:
         print("\nBest Global Hyperparameters:", best["params"])
         print(f"Best Global Metrics: {best['metrics']}")
-    weights = best.pop("weights")
+    weights = best["weights"] if keep_weights else best.pop("weights")
     best["weight_shapes"] = ([list(lyr["w"].shape) for lyr in weights["layers"]]
                              if weights else [])
     best["table"] = table
     return best
+
+
+def save_best_weights(path: str, best: dict) -> None:
+    """Persist the sweep winner — weights + hyperparameters + metrics — as
+    one ``.npz``. The reference only PRINTS the winning weight matrices
+    (hyperparameters_tuning.py:130-132); this makes the artifact real.
+    Requires ``run_grid_search(..., keep_weights=True)``."""
+    import json
+
+    weights = best.get("weights")
+    if not weights:
+        raise ValueError("best has no weights — run run_grid_search with "
+                         "keep_weights=True")
+    arrays = {}
+    for i, lyr in enumerate(weights["layers"]):
+        arrays[f"layers.{i}.w"] = np.asarray(lyr["w"])
+        arrays[f"layers.{i}.b"] = np.asarray(lyr["b"])
+    arrays["meta"] = np.frombuffer(json.dumps(
+        {"params": {"hidden_layer_sizes":
+                    list(best["params"]["hidden_layer_sizes"]),
+                    "learning_rate": best["params"]["learning_rate"]},
+         "metrics": best["metrics"],
+         "accuracy": best["accuracy"]}).encode(), dtype=np.uint8)
+    # Write through a file handle: np.savez(str_path) silently appends
+    # ".npz" when the suffix is missing, which would orphan the CLI's
+    # fail-fast-created file at the exact requested path.
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+
+def load_best_weights(path: str) -> dict:
+    """Inverse of ``save_best_weights``: returns ``{"weights": params_pytree,
+    "params": hyperparams, "metrics": ..., "accuracy": ...}``. The weights
+    pytree has the mlp layout (``{"layers": [{"w", "b"}, ...]}``) and plugs
+    directly into ``fedtpu.models.mlp.mlp_apply``."""
+    import json
+
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        n_layers = sum(1 for k in z.files if k.endswith(".w"))
+        layers = [{"w": z[f"layers.{i}.w"], "b": z[f"layers.{i}.b"]}
+                  for i in range(n_layers)]
+    return {"weights": {"layers": layers}, **meta}

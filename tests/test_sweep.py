@@ -42,3 +42,65 @@ def test_best_config_is_tracked():
     assert res["accuracy"] == max(r["accuracy"] for r in res["table"])
     assert set(res["params"]) == {"hidden_layer_sizes", "learning_rate"}
     assert res["weight_shapes"]  # averaged global weights were captured
+
+
+def test_best_weights_round_trip(tmp_path):
+    # VERDICT r1 missing item: the reference PRINTS the winning weight
+    # matrices (hyperparameters_tuning.py:130-132); fedtpu must persist
+    # them as a real artifact that round-trips and actually predicts.
+    import jax
+    from fedtpu.models.mlp import mlp_apply
+    from fedtpu.sweep.grid import load_best_weights, save_best_weights
+
+    cfg = _cfg()
+    ds = load_tabular_dataset(cfg.data)
+    res = run_grid_search(cfg, dataset=ds, hidden_grid=((8,), (4, 4)),
+                          lr_grid=(0.01, 0.05), local_steps=20,
+                          keep_weights=True, verbose=False)
+    assert res["weights"] is not None
+    path = str(tmp_path / "best.npz")
+    save_best_weights(path, res)
+
+    loaded = load_best_weights(path)
+    assert loaded["params"]["learning_rate"] == (
+        res["params"]["learning_rate"])
+    assert tuple(loaded["params"]["hidden_layer_sizes"]) == (
+        res["params"]["hidden_layer_sizes"])
+    assert loaded["accuracy"] == res["accuracy"]
+    jax.tree.map(np.testing.assert_array_equal,
+                 loaded["weights"], res["weights"])
+    # The restored pytree must plug straight into the model.
+    logits = mlp_apply(loaded["weights"], ds.x_train[:16])
+    assert logits.shape == (16, ds.num_classes)
+
+
+def test_weights_dropped_without_flag(tmp_path):
+    import pytest
+    from fedtpu.sweep.grid import save_best_weights
+
+    cfg = _cfg()
+    res = run_grid_search(cfg, hidden_grid=((8,),), lr_grid=(0.01,),
+                          local_steps=5, verbose=False)
+    assert "weights" not in res           # default: shapes only, as before
+    assert res["weight_shapes"]
+    with pytest.raises(ValueError, match="keep_weights"):
+        save_best_weights(str(tmp_path / "x.npz"), res)
+
+
+def test_cli_sweep_saves_weights(tmp_path):
+    from fedtpu.cli import main as cli_main
+    from fedtpu.sweep.grid import load_best_weights
+
+    out = tmp_path / "winner.npz"
+    # --hidden-sizes / --learning-rate narrow the sweep to ONE config (the
+    # flags must not be silently ignored — review r2): this runs a single
+    # tiny architecture, not the full 10x9 reference grid.
+    rc = cli_main(["sweep", "--csv", "", "--num-clients", "2",
+                   "--hidden-sizes", "8", "--learning-rate", "0.01",
+                   "--local-steps", "5",
+                   "--save-weights", str(out), "--quiet", "--json"])
+    assert rc == 0 or rc is None
+    loaded = load_best_weights(str(out))
+    assert tuple(loaded["params"]["hidden_layer_sizes"]) == (8,)
+    assert loaded["params"]["learning_rate"] == 0.01
+    assert len(loaded["weights"]["layers"]) == 2   # one hidden + head
