@@ -119,6 +119,10 @@ def _add_common_overrides(p: argparse.ArgumentParser):
     p.add_argument("--eval-test-every", type=int, default=None)
     p.add_argument("--rounds-per-step", type=int, default=None,
                    help="rounds scanned per compiled step (throughput knob)")
+    p.add_argument("--pipelined-stop", action="store_true",
+                   help="overlap metric processing with the next chunk's "
+                        "device execution; stop decisions lag one chunk "
+                        "(the reference's stop signal has the same lag)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of the round loop here")
     p.add_argument("--metrics-jsonl", default=None,
@@ -206,6 +210,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["metrics_jsonl"] = args.metrics_jsonl
     if args.log_per_client:
         run_kw["log_per_client"] = True
+    if getattr(args, "pipelined_stop", False):
+        run_kw["pipelined_stop"] = True
     if getattr(args, "model_parallel", None) is not None:
         run_kw["model_parallel"] = args.model_parallel
     if run_kw:

@@ -225,6 +225,13 @@ class RunConfig:
     # the round loop cleanly when loss or metrics go non-finite (diverged
     # run, bad lr), writing an emergency checkpoint if checkpoint_dir is set.
     halt_on_nonfinite: bool = True
+    # Overlap host-side metric processing with the NEXT chunk's device
+    # execution (one chunk kept in flight). Removes one dispatch+fetch RTT
+    # per chunk (the dominant per-chunk cost through a remote transport) at
+    # the price of stop decisions lagging one chunk — the reference's own
+    # stop-signal bcast has the same one-step lag (FL_CustomMLP...:132 vs
+    # :195). Default off: exact synchronous stop semantics.
+    pipelined_stop: bool = False
     # >1 selects the 2-D ('clients','model') GSPMD engine
     # (fedtpu.parallel.tp): hidden weights shard over a tensor-parallel axis
     # of this extent. MLP only; partial participation unsupported there.

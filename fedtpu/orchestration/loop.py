@@ -353,8 +353,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     def halt_diverged(reason: str, label_round: int):
         """Shared divergence halt: quarantine the poisoned state under
         diverged/ (so latest_step() — and therefore resume — still finds the
-        last GOOD periodic checkpoint) and stop the loop. Under chunking the
-        saved state is the chunk-end state; ``label_round`` says so."""
+        last GOOD periodic checkpoint) and stop the loop. ``label_round`` is
+        the round the CURRENT ``state`` corresponds to — under chunking the
+        chunk-end state; in pipelined mode possibly one chunk past the
+        divergent metrics (callers pass ``state_round``), so the quarantine
+        label always matches the saved state even when the history ends at
+        the earlier divergent round."""
         nonlocal stopped_early, diverged
         if verbose:
             print(f"Non-finite {reason}; halting (diverged run).", flush=True)
@@ -391,21 +395,35 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     # finalizes the profiler trace and closes the jsonl handle — the trace
     # exists precisely to diagnose such runs.
     try:
-        rnd = start_round
-        while rnd < cfg.fed.rounds and not stopped_early:
-            take = min(chunk, cfg.fed.rounds - rnd)
-            state, metrics = get_step(take)(state, batch)
+        def process_chunk(rnd0, take, metrics, state_round=None):
+            """Host-side consumption of one chunk's metrics: history, logs,
+            JSONL, divergence guard, early stopping. Fetches the metrics —
+            the completion proof AND (in pipelined mode) the point where
+            the host finally waits on this chunk. ``state_round``: the round
+            the loop's CURRENT ``state`` corresponds to (in pipelined mode
+            one chunk past this chunk's metrics) — used to label a
+            divergence quarantine honestly."""
+            if state_round is None:
+                state_round = rnd0 + take
+            nonlocal prev_metric, termination_count, stopped_early
+            nonlocal rounds_run
+            # ONE batched device->host transfer for the whole chunk's
+            # metrics: the per-round float()/np.asarray conversions below
+            # would otherwise each pay a serialized transfer round-trip
+            # (~13 per round; measured ~1.5 s/round through the tunneled
+            # transport vs ~20 ms for the batched fetch). Issue every
+            # leaf's transfer async first, then materialize — which is
+            # also the completion proof that must close the lap time
+            # (block_until_ready does not synchronize on this transport).
+            for leaf in jax.tree.leaves(metrics):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            metrics = jax.tree.map(np.asarray, metrics)
             per_round = _unstack_metrics(metrics, take)
-            # Completion proof BEFORE reading the lap time: on the tunneled
-            # axon transport, dispatch returns before the chunk has executed
-            # (block_until_ready does not synchronize there), so the lap
-            # must be closed by a host value fetch that depends on the
-            # whole chunk or ms/round would measure dispatch rate.
-            force_fetch(metrics["client_mean"]["accuracy"])
             dt = timer.lap() / take
 
             for j, m in enumerate(per_round):
-                r = rnd + j
+                r = rnd0 + j
                 client_mean = {k: float(v) for k, v in m["client_mean"].items()}
                 per_client = {k: np.asarray(v) for k, v in m["per_client"].items()}
                 losses.append(np.asarray(m["loss"]))
@@ -449,8 +467,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                         np.all(np.isfinite(cur))
                         and np.all(np.isfinite(losses[-1]))):
                     halt_diverged(f"loss/metrics at round {r + 1}",
-                                  rnd + take)
-                    break
+                                  state_round)
+                    return
 
                 # Early stopping — exact reference logic (FL_CustomMLP...:181-192).
                 if prev_metric is not None and np.allclose(
@@ -463,16 +481,52 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                                   f"{cfg.fed.termination_patience} rounds.",
                                   flush=True)
                         stopped_early = True
-                        break
+                        return
                 else:
                     prev_metric = cur
                     termination_count = cfg.fed.termination_patience
 
+        # Pipelined-stop mode (cfg.run.pipelined_stop): dispatch chunk k+1
+        # BEFORE processing chunk k's metrics, so the per-chunk host work
+        # (metric fetch + early-stop decision — one dispatch+fetch RTT,
+        # ~60-120 ms through the tunneled transport) overlaps the device
+        # executing the next chunk. The trade, documented and deliberate:
+        #   * stop decisions lag one chunk — when early stopping (or the
+        #     metric divergence guard) fires, one already-in-flight chunk
+        #     has trained past the stop; its metrics are DROPPED (history
+        #     matches the synchronous run exactly) but the final state
+        #     carries its training. The reference's own stop-signal bcast
+        #     has the same one-step lag (FL_CustomMLP...:132 vs :195).
+        #   * the chunk-end STATE finiteness gate is skipped between chunks
+        #     (fetching the in-flight state would serialize every chunk —
+        #     the exact cost this mode removes) and runs once at loop exit;
+        #     the per-round METRIC guard still runs every round, one chunk
+        #     late.
+        # Checkpoint / held-out-eval boundaries force their inherent sync
+        # and are unchanged. Default OFF: the synchronous loop keeps exact
+        # reference stop semantics.
+        pipelined = cfg.run.pipelined_stop
+        pending = None                      # (rnd0, take, metrics) in flight
+        rnd = start_round
+        while rnd < cfg.fed.rounds and not stopped_early:
+            take = min(chunk, cfg.fed.rounds - rnd)
+            state, metrics = get_step(take)(state, batch)
+            if pipelined:
+                if pending is not None:
+                    # The current `state` is the just-dispatched chunk's
+                    # output, ending at rnd + take.
+                    process_chunk(*pending, state_round=rnd + take)
+                pending = (rnd, take, metrics)
+            else:
+                process_chunk(rnd, take, metrics)
             rnd += take
 
             if stopped_early:
                 # The chunk overshot the stop round; don't checkpoint or eval the
                 # overshoot state (the unchunked loop's `break` skips these too).
+                # In pipelined mode `pending` is the in-flight overshoot chunk:
+                # dropped (see above).
+                pending = None
                 break
 
             # Chunk-end state check: metrics can stay finite for one round
@@ -481,11 +535,14 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # moments can overflow while params are still finite — so the
             # per-round metric guard above would let a periodic checkpoint
             # capture a poisoned state as "good". Gate the checkpoint on the
-            # actual full state (params + optimizer moments).
-            if cfg.run.halt_on_nonfinite and not bool(_tree_finite(
-                    {k: state[k] for k in
-                     ("params", "opt_state", "server_opt_state")
-                     if k in state})):
+            # actual full state (params + optimizer moments). Skipped
+            # per-chunk in pipelined mode (it would force a sync every
+            # chunk); runs at loop exit instead.
+            if (not pipelined) and cfg.run.halt_on_nonfinite and not bool(
+                    _tree_finite(
+                        {k: state[k] for k in
+                         ("params", "opt_state", "server_opt_state")
+                         if k in state})):
                 halt_diverged(f"params/optimizer state after round {rnd}",
                               rnd)
                 break
@@ -494,15 +551,26 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # chunk (with rounds_per_step=1 this is the exact per-round cadence).
             # Every due round appends an entry so test_hist round-alignment
             # matches the unchunked run; due rounds inside one chunk share the
-            # chunk-end global params (documented approximation).
-            if cfg.run.eval_test_every:
-                due = sum(1 for j in range(take)
-                          if (rnd - j) % cfg.run.eval_test_every == 0)
-                if due:
-                    tm = eval_step(global_params(state), ds.x_test, ds.y_test)
-                    for _ in range(due):
-                        for k in METRIC_NAMES:
-                            test_hist[k].append(float(tm[k]))
+            # chunk-end global params (documented approximation). In pipelined
+            # mode these fetch the in-flight state — an inherent sync, paid
+            # only on due boundaries; process the pending chunk first so
+            # history stays ordered.
+            eval_due = cfg.run.eval_test_every and sum(
+                1 for j in range(take)
+                if (rnd - j) % cfg.run.eval_test_every == 0)
+            ckpt_due = bool(ckpt_every and cfg.run.checkpoint_dir and any(
+                (rnd - j) % ckpt_every == 0 for j in range(take)))
+            if pipelined and pending is not None and (eval_due or ckpt_due):
+                process_chunk(*pending, state_round=rnd)
+                pending = None
+                if stopped_early:
+                    break
+
+            if eval_due:
+                tm = eval_step(global_params(state), ds.x_test, ds.y_test)
+                for _ in range(eval_due):
+                    for k in METRIC_NAMES:
+                        test_hist[k].append(float(tm[k]))
 
             # Checkpoint label semantics under chunking: a checkpoint due
             # mid-chunk is saved once at the chunk boundary, labeled with —
@@ -512,9 +580,20 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # `round_NNNN` labels therefore land on chunk ends rather than
             # on the exact due rounds; resume is consistent (label == state
             # == resume point), just coarser than the R=1 cadence.
-            if ckpt_every and cfg.run.checkpoint_dir and any(
-                    (rnd - j) % ckpt_every == 0 for j in range(take)):
+            if ckpt_due:
                 save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd)
+
+        if pending is not None and not stopped_early:
+            process_chunk(*pending, state_round=rnd)
+        if pipelined and not diverged and cfg.run.halt_on_nonfinite and (
+                not bool(_tree_finite(
+                    {k: state[k] for k in
+                     ("params", "opt_state", "server_opt_state")
+                     if k in state}))):
+            # The deferred state gate (see above) — label is the last
+            # completed round.
+            halt_diverged(f"params/optimizer state after round {rounds_run}",
+                          rounds_run)
 
     finally:
         if cfg.run.profile_dir:

@@ -91,6 +91,7 @@ QUICK_TESTS = {
     "test_participation.py::test_sampling_is_deterministic_in_seed",
     "test_participation.py::test_sampled_average_over_participants_only",
     "test_personalize.py::test_personalize_rejects_zero_steps",
+    "test_pipelined_stop.py::test_pipelined_divergence_still_halts",
     "test_personalize.py::test_personalization_off_by_default",
     "test_review_fixes.py::test_numeric_labels_reencoded_to_contiguous_indices",
     "test_review_fixes.py::test_empty_shards_excluded_from_client_mean",
