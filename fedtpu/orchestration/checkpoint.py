@@ -28,6 +28,13 @@ def _ckpt_path(directory: str, step: int) -> str:
     return os.path.join(os.path.abspath(directory), f"round_{step:06d}")
 
 
+def _identity(x):
+    """Module-level identity for reshard jits — a fresh lambda per call
+    would defeat jit's cache (keyed on function identity) and retrace per
+    leaf on every multi-process restore."""
+    return x
+
+
 def _strip_marker(state):
     """Drop the leafless 'shared_start' marker (fedtpu.parallel.round) from
     a state dict. The marker records how the LIVE state was constructed —
@@ -43,11 +50,20 @@ def save_checkpoint(directory: str, state, history: dict, step: int) -> str:
     """Write state + {history, step, num_clients} under
     ``directory/round_<step>``. ``num_clients`` lives in the tiny meta item
     so elastic-resume detection (fedtpu.orchestration.loop) never has to
-    read the full state twice on the common same-count path."""
+    read the full state twice on the common same-count path.
+
+    Multi-process (jax.distributed): EVERY process must call this — orbax
+    save is a collective (it barriers internally; a process-0-only call
+    deadlocks the job). The state is passed through as jax.Arrays so orbax
+    writes each client shard from the process that owns it (distributed
+    checkpointing over the shared checkpoint filesystem); single-process
+    keeps the simple host-numpy path."""
     path = _ckpt_path(directory, step)
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(os.path.join(path, "state"), to_numpy(_strip_marker(state)),
-               force=True)
+    state_item = _strip_marker(state)
+    if jax.process_count() == 1:
+        state_item = to_numpy(state_item)
+    ckptr.save(os.path.join(path, "state"), state_item, force=True)
     num_clients = jax.tree.leaves(state["params"])[0].shape[0]
     ckptr.save(os.path.join(path, "meta"),
                {"history": {k: np.asarray(v) for k, v in history.items()},
@@ -133,29 +149,45 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
     # _strip_marker), re-attached below from the live template.
     had_marker = isinstance(state_like, dict) and "shared_start" in state_like
     state_like = _strip_marker(state_like)
-    template = to_numpy(state_like) if state_like is not None else None
+    # Template from the live state's STRUCTURE only (shapes/dtypes/container
+    # types) — never fetch its values: under jax.distributed the
+    # client-sharded leaves are not host-addressable (to_numpy would raise),
+    # and orbax only reads the template's structure anyway.
+    template = (jax.tree.map(lambda l: np.zeros(np.shape(l), l.dtype),
+                             state_like)
+                if state_like is not None else None)
     state = ckptr.restore(os.path.join(path, "state"), item=template)
     meta = ckptr.restore(os.path.join(path, "meta"))
     def _mesh_sharding(like):
         s = getattr(like, "sharding", None)
         return s if isinstance(s, jax.sharding.NamedSharding) else None
 
+    def _place(l, sh):
+        """Put a restored leaf on sharding ``sh``. Under jax.distributed a
+        multi-process-saved checkpoint restores as GLOBAL jax.Arrays, which
+        ``jax.device_put`` refuses to reshard (not fully addressable) — an
+        identity jit with out_shardings does the reshard as an SPMD program
+        instead. Host/numpy and single-process leaves take the plain path."""
+        if isinstance(l, jax.Array) and not l.is_fully_addressable:
+            if sh is None:
+                return l                      # already a fine global array
+            return jax.jit(_identity, out_shardings=sh)(l)
+        return jax.device_put(l) if sh is None else jax.device_put(l, sh)
+
     if state_like is not None and any(
             _mesh_sharding(l) is not None for l in jax.tree.leaves(state_like)):
         # Mesh-laid-out leaves reuse their template sharding; scalars (the
         # round counter) stay uncommitted so jit can place them freely.
         state = jax.tree.map(
-            lambda l, like: (jax.device_put(l, _mesh_sharding(like))
-                             if _mesh_sharding(like) is not None
-                             else jax.device_put(l)),
+            lambda l, like: _place(l, _mesh_sharding(like)),
             state, state_like)
     elif sharding is not None:
         # Every non-scalar state leaf carries the leading clients axis
         # (params, Adam moments); scalars (the round counter, Adam counts of
         # shape (C,) stay client-sharded too since ndim >= 1).
         state = jax.tree.map(
-            lambda l: (jax.device_put(l, sharding)
-                       if getattr(l, "ndim", 0) >= 1 else jax.device_put(l)),
+            lambda l: _place(l, sharding if getattr(l, "ndim", 0) >= 1
+                             else None),
             state)
     if had_marker:
         state["shared_start"] = ()

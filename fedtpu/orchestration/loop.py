@@ -278,6 +278,33 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     exp = build_experiment(cfg, dataset)
     state, batch, eval_step, ds = exp.state, exp.batch, exp.eval_step, exp.dataset
 
+    # Multi-process (multi-host) awareness — the reference runs its WHOLE
+    # driver under `mpirun --hostfile`, so the whole loop must run under
+    # jax.distributed too (tests/test_multihost_e2e.py runs it across two
+    # OS processes). Three rules:
+    #   * anything fetched to host must be FULLY REPLICATED first —
+    #     per-client leaves are client-sharded across processes and not
+    #     host-addressable; `_rep` re-lays a pytree out replicated (GSPMD
+    #     inserts the cross-host all-gathers), which also keeps the
+    #     early-stop/divergence control flow consensual on every process;
+    #   * print/JSONL side effects happen on process 0 only — but NOT
+    #     checkpoint writes: orbax save is a collective (every process must
+    #     call it or the job deadlocks in orbax's internal barrier), with
+    #     each process persisting the client shards it owns to the shared
+    #     checkpoint filesystem;
+    #   * control flow (early stop, divergence, round counters) stays
+    #     identical on every process because it is derived from the
+    #     replicated metrics.
+    multiproc = jax.process_count() > 1
+    io_proc = jax.process_index() == 0
+    if multiproc:
+        from jax.sharding import NamedSharding, PartitionSpec
+        _rep = jax.jit(lambda t: t, out_shardings=NamedSharding(
+            exp.mesh, PartitionSpec()))
+        verbose = verbose and io_proc
+    else:
+        _rep = lambda t: t
+
     start_round = 0
     restored_history = None
     if resume and cfg.run.checkpoint_dir:
@@ -363,8 +390,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         if verbose:
             print(f"Non-finite {reason}; halting (diverged run).", flush=True)
         if cfg.run.checkpoint_dir:
-            save_checkpoint(os.path.join(cfg.run.checkpoint_dir, "diverged"),
-                            state, history, label_round)
+            # All processes reach here together (the decision derives from
+            # replicated metrics/state) and all must call the save — orbax
+            # barriers internally (see save_checkpoint).
+            save_checkpoint(
+                os.path.join(cfg.run.checkpoint_dir, "diverged"),
+                state, history, label_round)
         stopped_early = True
         diverged = True
 
@@ -385,7 +416,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         return step_fns[r]
 
     jsonl = (open(cfg.run.metrics_jsonl, "a")
-             if cfg.run.metrics_jsonl else None)
+             if cfg.run.metrics_jsonl and io_proc else None)
     if cfg.run.profile_dir:
         # Tracing subsystem the reference lacks entirely (SURVEY.md §5):
         # capture a device profile of the round loop for xprof/tensorboard.
@@ -415,6 +446,9 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # leaf's transfer async first, then materialize — which is
             # also the completion proof that must close the lap time
             # (block_until_ready does not synchronize on this transport).
+            # Multi-process: replicate first (collective, every process) so
+            # the client-sharded leaves become host-addressable everywhere.
+            metrics = _rep(metrics)
             for leaf in jax.tree.leaves(metrics):
                 if hasattr(leaf, "copy_to_host_async"):
                     leaf.copy_to_host_async()
@@ -567,7 +601,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     break
 
             if eval_due:
-                tm = eval_step(global_params(state), ds.x_test, ds.y_test)
+                # _rep: the global slice of a client-sharded array is not
+                # host-addressable from every process; replicated params
+                # also make the eval jit's output fetchable everywhere.
+                tm = eval_step(_rep(global_params(state)),
+                               ds.x_test, ds.y_test)
                 for _ in range(eval_due):
                     for k in METRIC_NAMES:
                         test_hist[k].append(float(tm[k]))
@@ -581,6 +619,10 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # on the exact due rounds; resume is consistent (label == state
             # == resume point), just coarser than the R=1 cadence.
             if ckpt_due:
+                # EVERY process calls this: orbax save is itself a
+                # collective (barriers internally — a process-0-only call
+                # deadlocks), and it writes each client shard from the
+                # process that owns it (true distributed checkpointing).
                 save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd)
 
         if pending is not None and not stopped_early:
@@ -619,6 +661,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         # final_params stay the GLOBAL model, which is what checkpoints and
         # downstream eval use).
         _, pm = exp.personalize_fn(state["params"], batch)
+        pm = _rep(pm)
         personalized = {
             "per_client": {k: np.asarray(v)
                            for k, v in pm["per_client"].items()},
@@ -640,7 +683,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         sec_per_round=sec_per_round,
         rounds_run=rounds_run,
         stopped_early=stopped_early,
-        final_params=to_numpy(global_params(state)),
+        final_params=to_numpy(_rep(global_params(state))),
         config=cfg,
         diverged=diverged,
         personalized_metrics=personalized,

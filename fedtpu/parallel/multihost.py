@@ -29,6 +29,18 @@ devices each, wires them into one jax.distributed runtime, and runs the full
 round program over the global 8-client mesh — the FedAvg collectives cross
 the process boundary over TCP/gloo (the CPU stand-in for DCN) and both
 processes hold the identical global model, matching the single-process run.
+
+The COMPLETE orchestration loop is multi-process-aware too (the reference
+runs its whole driver under ``mpirun --hostfile``, so fedtpu's
+``run_experiment`` must run whole under ``jax.distributed``): host-fetched
+metrics are replicated in-graph first (client-sharded leaves are not
+addressable across processes), prints/JSONL go to process 0 only, orbax
+checkpoints are written as a collective with each process persisting the
+client shards it owns, and control flow (early stop, divergence, pipelined
+stop) stays consensual because it derives from the replicated metrics.
+Executed end-to-end — history, held-out eval, pipelined stop, periodic
+checkpoints — across two OS processes by the full-loop tests in
+tests/test_multihost_e2e.py, matching the single-process histories exactly.
 """
 
 from __future__ import annotations

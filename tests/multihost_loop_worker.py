@@ -1,0 +1,98 @@
+"""Worker for the multi-process FULL-LOOP test (tests/test_multihost_e2e.py).
+
+Where tests/multihost_worker.py validates the raw round program across two
+jax.distributed processes, this worker runs the COMPLETE orchestration loop
+— run_experiment with history, early stopping, and held-out eval — the way
+the reference runs its whole ``train_and_evaluate`` driver under ``mpirun
+--hostfile``. Each process writes its recorded history; the parent test
+asserts both processes and the single-process run agree.
+"""
+
+import json
+import os
+import sys
+
+ROWS, FEATURES, CLASSES = 200, 6, 2
+NUM_CLIENTS = 8
+HIDDEN = (8,)
+ROUNDS = 8
+ROUNDS_PER_STEP = 2
+EVAL_TEST_EVERY = 4
+RESUME_ROUNDS = 12      # pipelined_ckpt mode: second leg resumes 8 -> 12
+
+
+def experiment_config(mode: str = "plain", ckpt_dir=None):
+    """``plain``: the default synchronous loop. ``pipelined_ckpt``: the
+    pipelined-stop loop with periodic checkpointing — the interaction where
+    the collective state replication and process-0-only write must line up
+    across processes."""
+    from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                               ModelConfig, RunConfig, ShardConfig)
+    run_kw = {}
+    if mode == "pipelined_ckpt":
+        run_kw = {"pipelined_stop": True, "checkpoint_dir": ckpt_dir,
+                  "checkpoint_every": 4}
+    return ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=ROWS,
+                        synthetic_features=FEATURES),
+        shard=ShardConfig(num_clients=NUM_CLIENTS, shuffle=False),
+        model=ModelConfig(input_dim=FEATURES, hidden_sizes=HIDDEN),
+        fed=FedConfig(rounds=ROUNDS, tolerance=0.0, same_init=True),
+        run=RunConfig(rounds_per_step=ROUNDS_PER_STEP,
+                      eval_test_every=EVAL_TEST_EVERY, **run_kw),
+    )
+
+
+def main():
+    pid, nprocs, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                 sys.argv[3], sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "plain"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from fedtpu.parallel import multihost
+
+    multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs
+
+    import numpy as np
+    from fedtpu.orchestration.loop import run_experiment
+
+    ckpt_dir = os.path.join(outdir, "ck")
+    res = run_experiment(experiment_config(mode, ckpt_dir), verbose=True)
+
+    out = {
+        "mode": mode,
+        "rounds_run": res.rounds_run,
+        "accuracy": [float(v) for v in res.global_metrics["accuracy"]],
+        "f1": [float(v) for v in res.global_metrics["f1"]],
+        "test_accuracy": [float(v) for v in res.test_metrics["accuracy"]],
+        "per_client_last": np.asarray(
+            res.per_client_metrics["accuracy"][-1]).tolist(),
+    }
+    if mode == "pipelined_ckpt":
+        # Resume leg: a fresh run_experiment restores the DISTRIBUTED
+        # checkpoint (written collectively above) on every process and
+        # continues the round loop — the multi-process restore path.
+        import dataclasses
+        cfg2 = experiment_config(mode, ckpt_dir)
+        cfg2 = dataclasses.replace(
+            cfg2, fed=dataclasses.replace(cfg2.fed, rounds=RESUME_ROUNDS))
+        res2 = run_experiment(cfg2, verbose=False, resume=True)
+        out["resume_rounds_run"] = res2.rounds_run
+        out["resume_accuracy"] = [float(v)
+                                  for v in res2.global_metrics["accuracy"]]
+
+    with open(os.path.join(outdir, f"loop_{pid}.json"), "w") as f:
+        json.dump(out, f)
+    print(f"loop worker {pid}: ok rounds={res.rounds_run}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -25,13 +25,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_workers(tmp_path):
-    """Run both workers to completion; always reaps the processes. The
-    free-port probe is inherently racy (the port is released before the
-    coordinator binds it), so one retry with a fresh port absorbs a lost
-    race instead of flaking."""
+def _launch_pair(worker_name, trailing_args, timeout):
+    """Run a 2-process worker pair to completion; always reaps the
+    processes. The free-port probe is inherently racy (the port is released
+    before the coordinator binds it), so one retry with a fresh port
+    absorbs a lost race instead of flaking."""
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "multihost_worker.py")
+                          worker_name)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     last = None
@@ -39,11 +39,11 @@ def _launch_workers(tmp_path):
         port = _free_port()
         procs = [subprocess.Popen(
             [sys.executable, worker, str(pid), "2", str(port),
-             str(tmp_path)],
+             *trailing_args],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env) for pid in (0, 1)]
         try:
-            outs = [p.communicate(timeout=240)[0] for p in procs]
+            outs = [p.communicate(timeout=timeout)[0] for p in procs]
         except subprocess.TimeoutExpired:
             outs = ["<timeout>", "<timeout>"]
         finally:
@@ -55,7 +55,11 @@ def _launch_workers(tmp_path):
         if all(p.returncode == 0 for p, _ in last):
             return
     for p, out in last:
-        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+
+
+def _launch_workers(tmp_path):
+    _launch_pair("multihost_worker.py", [str(tmp_path)], timeout=240)
 
 
 def test_two_process_round_matches_single_process(tmp_path):
@@ -103,3 +107,79 @@ def test_two_process_round_matches_single_process(tmp_path):
         state, _ = step(state, batch)
     single = np.asarray(jax.tree.leaves(state["params"])[0])[0]
     np.testing.assert_allclose(p0, single, atol=1e-5)
+
+
+def _launch_loop_workers(tmp_path, mode="plain"):
+    _launch_pair("multihost_loop_worker.py", [str(tmp_path), mode],
+                 timeout=300)
+
+
+def test_two_process_full_loop_matches_single_process(tmp_path):
+    """The COMPLETE orchestration loop (run_experiment: history, held-out
+    eval, early-stop machinery) across two jax.distributed processes — the
+    reference's whole mpirun driver, not just the round kernel. Both
+    processes must record identical histories, matching the single-process
+    run."""
+    import json
+
+    from tests import multihost_loop_worker as mlw
+
+    _launch_loop_workers(tmp_path)
+    runs = []
+    for pid in (0, 1):
+        with open(tmp_path / f"loop_{pid}.json") as f:
+            runs.append(json.load(f))
+    # Identical recorded histories on every process.
+    assert runs[0] == runs[1]
+    assert runs[0]["rounds_run"] == mlw.ROUNDS
+    assert len(runs[0]["test_accuracy"]) == mlw.ROUNDS // mlw.EVAL_TEST_EVERY
+
+    # Single-process reference run of the same config in this pytest
+    # process (8 virtual devices, one process).
+    from fedtpu.orchestration.loop import run_experiment
+
+    single = run_experiment(mlw.experiment_config(), verbose=False)
+    np.testing.assert_allclose(runs[0]["accuracy"],
+                               single.global_metrics["accuracy"], atol=1e-5)
+    np.testing.assert_allclose(runs[0]["test_accuracy"],
+                               single.test_metrics["accuracy"], atol=1e-5)
+    np.testing.assert_allclose(
+        runs[0]["per_client_last"],
+        np.asarray(single.per_client_metrics["accuracy"][-1]), atol=1e-5)
+
+
+def test_two_process_pipelined_loop_with_checkpointing(tmp_path):
+    """Pipelined-stop + periodic checkpointing across two processes. The
+    orbax save is a COLLECTIVE — every process calls it (a process-0-only
+    call deadlocks inside orbax's barrier; process-0 gating applies only to
+    prints/JSONL), each persisting the client shards it owns. History must
+    still match the single-process run, and a resume leg must continue from
+    the distributed checkpoint."""
+    import json
+
+    from tests import multihost_loop_worker as mlw
+
+    _launch_loop_workers(tmp_path, mode="pipelined_ckpt")
+    runs = []
+    for pid in (0, 1):
+        with open(tmp_path / f"loop_{pid}.json") as f:
+            runs.append(json.load(f))
+    assert runs[0] == runs[1]
+    assert runs[0]["rounds_run"] == mlw.ROUNDS
+
+    # The collective saves landed on the shared dir (written jointly by
+    # both processes, each persisting its own client shards): the first
+    # leg's round-8 checkpoint plus the resume leg's round-12 one.
+    from fedtpu.orchestration.checkpoint import latest_step
+    assert latest_step(str(tmp_path / "ck")) == mlw.RESUME_ROUNDS
+    assert (tmp_path / "ck" / f"round_{mlw.ROUNDS:06d}").is_dir()
+
+    # The worker's resume leg continued from the distributed checkpoint to
+    # RESUME_ROUNDS on both processes with a consistent extended history.
+    assert runs[0]["resume_rounds_run"] == mlw.RESUME_ROUNDS
+    assert len(runs[0]["resume_accuracy"]) == mlw.RESUME_ROUNDS
+
+    from fedtpu.orchestration.loop import run_experiment
+    single = run_experiment(mlw.experiment_config(), verbose=False)
+    np.testing.assert_allclose(runs[0]["accuracy"],
+                               single.global_metrics["accuracy"], atol=1e-5)
