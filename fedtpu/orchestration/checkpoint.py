@@ -21,18 +21,13 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-from fedtpu.utils.trees import to_numpy
+from fedtpu.utils.trees import identity, to_numpy
 
 
 def _ckpt_path(directory: str, step: int) -> str:
     return os.path.join(os.path.abspath(directory), f"round_{step:06d}")
 
 
-def _identity(x):
-    """Module-level identity for reshard jits — a fresh lambda per call
-    would defeat jit's cache (keyed on function identity) and retrace per
-    leaf on every multi-process restore."""
-    return x
 
 
 def _strip_marker(state):
@@ -171,7 +166,7 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
         if isinstance(l, jax.Array) and not l.is_fully_addressable:
             if sh is None:
                 return l                      # already a fine global array
-            return jax.jit(_identity, out_shardings=sh)(l)
+            return jax.jit(identity, out_shardings=sh)(l)
         return jax.device_put(l) if sh is None else jax.device_put(l, sh)
 
     if state_like is not None and any(

@@ -299,7 +299,10 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     io_proc = jax.process_index() == 0
     if multiproc:
         from jax.sharding import NamedSharding, PartitionSpec
-        _rep = jax.jit(lambda t: t, out_shardings=NamedSharding(
+        from fedtpu.utils.trees import identity
+        # Module-level `identity` (not a lambda) so repeated run_experiment
+        # calls in one process hit the jit cache instead of retracing.
+        _rep = jax.jit(identity, out_shardings=NamedSharding(
             exp.mesh, PartitionSpec()))
         verbose = verbose and io_proc
     else:

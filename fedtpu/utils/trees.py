@@ -15,6 +15,15 @@ def tree_bytes(tree) -> int:
                for l in jax.tree.leaves(tree))
 
 
+def identity(tree):
+    """Module-level identity for reshard/replicate jits
+    (``jax.jit(identity, out_shardings=...)``): jit's cache is keyed on
+    function identity, so a fresh lambda per call site would retrace and
+    recompile every time. Shared by the orchestration loop's metric
+    replication and the checkpoint restore's reshard."""
+    return tree
+
+
 def to_numpy(tree):
     """Device -> host copy of a whole pytree."""
     return jax.tree.map(lambda l: np.asarray(jax.device_get(l)), tree)
