@@ -24,14 +24,18 @@ RESUME_ROUNDS = 12      # pipelined_ckpt mode: second leg resumes 8 -> 12
 def experiment_config(mode: str = "plain", ckpt_dir=None):
     """``plain``: the default synchronous loop. ``pipelined_ckpt``: the
     pipelined-stop loop with periodic checkpointing — the interaction where
-    the collective state replication and process-0-only write must line up
-    across processes."""
+    the collective orbax save must line up across processes. ``tp``: the
+    2-D GSPMD engine (model_parallel=2) on a ('clients','model') mesh that
+    spans both processes — Megatron-sharded hidden weights with their
+    collectives crossing the process boundary."""
     from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
                                ModelConfig, RunConfig, ShardConfig)
     run_kw = {}
     if mode == "pipelined_ckpt":
         run_kw = {"pipelined_stop": True, "checkpoint_dir": ckpt_dir,
                   "checkpoint_every": 4}
+    elif mode == "tp":
+        run_kw = {"model_parallel": 2}
     return ExperimentConfig(
         data=DataConfig(csv_path=None, synthetic_rows=ROWS,
                         synthetic_features=FEATURES),

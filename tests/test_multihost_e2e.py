@@ -114,23 +114,30 @@ def _launch_loop_workers(tmp_path, mode="plain"):
                  timeout=300)
 
 
+def _run_loop_workers(tmp_path, mode="plain"):
+    """Launch the 2-process loop-worker pair and return the per-process
+    result dicts, asserting cross-process equality — the shared contract of
+    every full-loop test."""
+    import json
+
+    _launch_loop_workers(tmp_path, mode=mode)
+    runs = []
+    for pid in (0, 1):
+        with open(tmp_path / f"loop_{pid}.json") as f:
+            runs.append(json.load(f))
+    assert runs[0] == runs[1]
+    return runs
+
+
 def test_two_process_full_loop_matches_single_process(tmp_path):
     """The COMPLETE orchestration loop (run_experiment: history, held-out
     eval, early-stop machinery) across two jax.distributed processes — the
     reference's whole mpirun driver, not just the round kernel. Both
     processes must record identical histories, matching the single-process
     run."""
-    import json
-
     from tests import multihost_loop_worker as mlw
 
-    _launch_loop_workers(tmp_path)
-    runs = []
-    for pid in (0, 1):
-        with open(tmp_path / f"loop_{pid}.json") as f:
-            runs.append(json.load(f))
-    # Identical recorded histories on every process.
-    assert runs[0] == runs[1]
+    runs = _run_loop_workers(tmp_path)
     assert runs[0]["rounds_run"] == mlw.ROUNDS
     assert len(runs[0]["test_accuracy"]) == mlw.ROUNDS // mlw.EVAL_TEST_EVERY
 
@@ -155,16 +162,9 @@ def test_two_process_pipelined_loop_with_checkpointing(tmp_path):
     prints/JSONL), each persisting the client shards it owns. History must
     still match the single-process run, and a resume leg must continue from
     the distributed checkpoint."""
-    import json
-
     from tests import multihost_loop_worker as mlw
 
-    _launch_loop_workers(tmp_path, mode="pipelined_ckpt")
-    runs = []
-    for pid in (0, 1):
-        with open(tmp_path / f"loop_{pid}.json") as f:
-            runs.append(json.load(f))
-    assert runs[0] == runs[1]
+    runs = _run_loop_workers(tmp_path, mode="pipelined_ckpt")
     assert runs[0]["rounds_run"] == mlw.ROUNDS
 
     # The collective saves landed on the shared dir (written jointly by
@@ -181,5 +181,21 @@ def test_two_process_pipelined_loop_with_checkpointing(tmp_path):
 
     from fedtpu.orchestration.loop import run_experiment
     single = run_experiment(mlw.experiment_config(), verbose=False)
+    np.testing.assert_allclose(runs[0]["accuracy"],
+                               single.global_metrics["accuracy"], atol=1e-5)
+
+
+def test_two_process_tensor_parallel_loop(tmp_path):
+    """The 2-D dp x tp GSPMD engine across two processes: a (4, 2)
+    ('clients','model') mesh spanning both, Megatron-sharded hidden
+    weights, full loop. Histories must agree across processes and match
+    the single-process 2-D run."""
+    from tests import multihost_loop_worker as mlw
+
+    runs = _run_loop_workers(tmp_path, mode="tp")
+    assert runs[0]["rounds_run"] == mlw.ROUNDS
+
+    from fedtpu.orchestration.loop import run_experiment
+    single = run_experiment(mlw.experiment_config("tp"), verbose=False)
     np.testing.assert_allclose(runs[0]["accuracy"],
                                single.global_metrics["accuracy"], atol=1e-5)
