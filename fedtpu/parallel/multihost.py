@@ -41,6 +41,10 @@ stop) stays consensual because it derives from the replicated metrics.
 Executed end-to-end — history, held-out eval, pipelined stop, periodic
 checkpoints — across two OS processes by the full-loop tests in
 tests/test_multihost_e2e.py, matching the single-process histories exactly.
+All three reference drivers are multi-process-validated there: the
+multi-round FedAvg loop (both engines: 1-D shard_map and 2-D dp x tp
+GSPMD), and the hyperparameter grid search (whose fetched results are
+fully replicated, so it runs under jax.distributed unmodified).
 """
 
 from __future__ import annotations

@@ -26,8 +26,11 @@ def experiment_config(mode: str = "plain", ckpt_dir=None):
     pipelined-stop loop with periodic checkpointing — the interaction where
     the collective orbax save must line up across processes. ``tp``: the
     2-D GSPMD engine (model_parallel=2) on a ('clients','model') mesh that
-    spans both processes — Megatron-sharded hidden weights with their
-    collectives crossing the process boundary."""
+    spans both processes. Coverage stated honestly: with devices laid out
+    (dp=4, tp=2) each model-axis PAIR is intra-process — it is the
+    clients-axis collectives (FedAvg psum, metric gathers) that cross the
+    process boundary, exercising the full loop over a Megatron-sharded
+    model, not tp-over-DCN itself."""
     from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
                                ModelConfig, RunConfig, ShardConfig)
     run_kw = {}
@@ -67,6 +70,36 @@ def main():
 
     import numpy as np
     from fedtpu.orchestration.loop import run_experiment
+
+    if mode == "sweep":
+        # The reference's third driver (hyperparameters_tuning.py) under
+        # multi-process: the vmapped-LR federated grid over the global
+        # mesh. Every fetched array (pooled metrics, averaged winner
+        # weights) is fully replicated, so the host reads work on every
+        # process without extra plumbing.
+        from fedtpu.sweep.grid import run_grid_search
+
+        cfg = experiment_config()
+        best = run_grid_search(cfg, hidden_grid=((8,), (4, 4)),
+                               lr_grid=(0.01, 0.05), local_steps=10,
+                               keep_weights=True, verbose=False)
+        out = {
+            "mode": mode,
+            "best_params": {
+                "hidden_layer_sizes":
+                    list(best["params"]["hidden_layer_sizes"]),
+                "learning_rate": best["params"]["learning_rate"]},
+            "best_accuracy": best["accuracy"],
+            "table": [[list(r["hidden_layer_sizes"]), r["learning_rate"],
+                       r["accuracy"]] for r in best["table"]],
+            "weights_w0_sum": float(
+                np.asarray(best["weights"]["layers"][0]["w"]).sum()),
+        }
+        with open(os.path.join(outdir, f"loop_{pid}.json"), "w") as f:
+            json.dump(out, f)
+        print(f"sweep worker {pid}: ok best={out['best_params']}",
+              flush=True)
+        return
 
     ckpt_dir = os.path.join(outdir, "ck")
     res = run_experiment(experiment_config(mode, ckpt_dir), verbose=True)

@@ -199,3 +199,39 @@ def test_two_process_tensor_parallel_loop(tmp_path):
     single = run_experiment(mlw.experiment_config("tp"), verbose=False)
     np.testing.assert_allclose(runs[0]["accuracy"],
                                single.global_metrics["accuracy"], atol=1e-5)
+
+
+def test_two_process_grid_search(tmp_path):
+    """The reference's third driver — the federated hyperparameter grid
+    (hyperparameters_tuning.py runs under mpirun) — across two processes:
+    vmapped learning rates, uniform averaging, winner tracking with
+    weights. Results must agree across processes and with the
+    single-process sweep."""
+    from tests import multihost_loop_worker as mlw
+
+    runs = _run_loop_workers(tmp_path, mode="sweep")
+    assert runs[0]["best_params"]["hidden_layer_sizes"]
+
+    from fedtpu.sweep.grid import run_grid_search
+
+    single = run_grid_search(mlw.experiment_config(),
+                             hidden_grid=((8,), (4, 4)),
+                             lr_grid=(0.01, 0.05), local_steps=10,
+                             keep_weights=True, verbose=False)
+    assert runs[0]["best_params"] == {
+        "hidden_layer_sizes":
+            list(single["params"]["hidden_layer_sizes"]),
+        "learning_rate": single["params"]["learning_rate"]}
+    np.testing.assert_allclose(runs[0]["best_accuracy"],
+                               single["accuracy"], atol=1e-5)
+    # The replicated winner-weights artifact must match the single-process
+    # sweep too (keep_weights path across processes).
+    np.testing.assert_allclose(
+        runs[0]["weights_w0_sum"],
+        float(np.asarray(single["weights"]["layers"][0]["w"]).sum()),
+        atol=1e-4)
+    assert len(runs[0]["table"]) == len(single["table"]) == 4
+    for (hl, lr, acc), row in zip(runs[0]["table"], single["table"]):
+        assert tuple(hl) == row["hidden_layer_sizes"]
+        assert lr == row["learning_rate"]
+        np.testing.assert_allclose(acc, row["accuracy"], atol=1e-5)
