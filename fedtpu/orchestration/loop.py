@@ -298,12 +298,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     multiproc = jax.process_count() > 1
     io_proc = jax.process_index() == 0
     if multiproc:
-        from jax.sharding import NamedSharding, PartitionSpec
+        from fedtpu.parallel.mesh import replicated_sharding
         from fedtpu.utils.trees import identity
         # Module-level `identity` (not a lambda) so repeated run_experiment
         # calls in one process hit the jit cache instead of retracing.
-        _rep = jax.jit(identity, out_shardings=NamedSharding(
-            exp.mesh, PartitionSpec()))
+        _rep = jax.jit(identity,
+                       out_shardings=replicated_sharding(exp.mesh))
         verbose = verbose and io_proc
     else:
         _rep = lambda t: t

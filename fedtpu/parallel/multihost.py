@@ -44,7 +44,12 @@ tests/test_multihost_e2e.py, matching the single-process histories exactly.
 All three reference drivers are multi-process-validated there: the
 multi-round FedAvg loop (both engines: 1-D shard_map and 2-D dp x tp
 GSPMD), and the hyperparameter grid search (whose fetched results are
-fully replicated, so it runs under jax.distributed unmodified).
+fully replicated, so it runs under jax.distributed unmodified). The
+kernel-level worker additionally exercises the explicit ring (ppermute)
+aggregation with its hops crossing the process boundary, and true
+tp-over-DCN — a transposed ('clients','model') mesh whose model-axis
+pairs each span both processes, so the Megatron col/row collectives
+themselves ride the inter-process link.
 """
 
 from __future__ import annotations

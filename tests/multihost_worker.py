@@ -84,6 +84,69 @@ def main():
         f.write(repr(acc))
     print(f"worker {pid}: ok acc={acc:.4f}", flush=True)
 
+    # --- Explicit ring (ppermute) aggregation ACROSS the process boundary.
+    # psum lets XLA choose the collective; the ring path spells out the
+    # rotate-accumulate schedule (fedtpu/parallel/ring.py) — here its
+    # ppermute hops genuinely cross processes over TCP/gloo. One round from
+    # a fresh same-init state must match the psum path bit-for-bit up to
+    # reassociation.
+    from fedtpu.parallel.mesh import replicated_sharding
+    from fedtpu.utils.trees import identity
+
+    def fetch_global(tree, m):
+        """Full global host value of a sharded pytree: replicate in-graph
+        (collective — every process executes it), then fetch locally.
+        Module-level `identity` so repeated calls hit the jit cache."""
+        rep = jax.jit(identity, out_shardings=replicated_sharding(m))
+        return jax.tree.map(np.asarray, rep(tree))
+
+    ring_state = init_federated_state(jax.random.key(SEED), mesh,
+                                      NUM_CLIENTS, init_fn, tx,
+                                      same_init=True)
+    psum_state = init_federated_state(jax.random.key(SEED), mesh,
+                                      NUM_CLIENTS, init_fn, tx,
+                                      same_init=True)
+    ring_step = build_round_fn(mesh, apply_fn, tx, CLASSES,
+                               aggregation="ring")
+    psum_step = build_round_fn(mesh, apply_fn, tx, CLASSES)
+    ring_state, _ = ring_step(ring_state, batch)
+    psum_state, _ = psum_step(psum_state, batch)
+    ring_g = fetch_global(ring_state["params"], mesh)
+    psum_g = fetch_global(psum_state["params"], mesh)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+                 ring_g, psum_g)
+    print(f"worker {pid}: ring == psum across processes ok", flush=True)
+
+    # --- True tp-over-DCN: a ('clients','model') mesh whose MODEL-axis
+    # pairs each span BOTH processes (devices [[0,4],[1,5],[2,6],[3,7]]),
+    # so the Megatron col/row collectives themselves cross the process
+    # boundary — unlike make_mesh_2d's default layout, where tp pairs are
+    # intra-process. One 2-D round must match the 1-D engine's round.
+    from jax.sharding import Mesh
+    from fedtpu.parallel import tp
+    from fedtpu.parallel.mesh import CLIENTS_AXIS
+
+    devs = np.asarray(jax.devices()).reshape(2, 4).T   # (4, 2): tp crosses
+    mesh2 = Mesh(devs, (CLIENTS_AXIS, tp.MODEL_AXIS))
+    shard2 = tp.batch_sharding_2d(mesh2)
+    # Same host-global data on every process + cross-process sharding —
+    # the pattern build_experiment relies on.
+    batch2 = {k: jax.device_put(v, shard2)
+              for k, v in {"x": packed.x, "y": packed.y,
+                           "mask": packed.mask}.items()}
+    state2 = tp.init_federated_state_2d(jax.random.key(SEED), mesh2,
+                                        NUM_CLIENTS, init_fn, tx,
+                                        same_init=True)
+    step2 = tp.build_round_fn_2d(mesh2, apply_fn, tx, CLASSES)
+    state2, m2 = step2(state2, batch2)
+    tp_g = fetch_global(state2["params"], mesh2)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4),
+                 tp_g, psum_g)
+    acc2 = float(np.asarray(m2["client_mean"]["accuracy"]))
+    with open(os.path.join(outdir, f"tp_acc_{pid}.txt"), "w") as f:
+        f.write(repr(acc2))
+    print(f"worker {pid}: tp-over-DCN round ok acc={acc2:.4f}", flush=True)
+
 
 if __name__ == "__main__":
     main()

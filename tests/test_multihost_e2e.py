@@ -75,6 +75,16 @@ def test_two_process_round_matches_single_process(tmp_path):
     assert accs[0] == accs[1]
     assert np.isfinite(accs[0])
 
+    # The worker also ran (a) explicit ring/ppermute aggregation with its
+    # hops crossing the process boundary (asserted == psum in-worker) and
+    # (b) a 2-D round on a transposed mesh whose MODEL-axis pairs span
+    # both processes — true tp-over-DCN (asserted == the 1-D round
+    # in-worker). Cross-process agreement of the tp metrics:
+    tp_accs = [float(open(tmp_path / f"tp_acc_{pid}.txt").read())
+               for pid in (0, 1)]
+    assert tp_accs[0] == tp_accs[1]
+    assert np.isfinite(tp_accs[0])
+
     # Cross-check against the single-process 8-device run (the pytest
     # process's own virtual mesh), same constants imported from the worker
     # module so the two programs cannot drift: collective order may
