@@ -119,10 +119,6 @@ def _add_common_overrides(p: argparse.ArgumentParser):
     p.add_argument("--eval-test-every", type=int, default=None)
     p.add_argument("--rounds-per-step", type=int, default=None,
                    help="rounds scanned per compiled step (throughput knob)")
-    p.add_argument("--pipelined-stop", action="store_true",
-                   help="overlap metric processing with the next chunk's "
-                        "device execution; stop decisions lag one chunk "
-                        "(the reference's stop signal has the same lag)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of the round loop here")
     p.add_argument("--metrics-jsonl", default=None,
@@ -195,6 +191,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
     if args.byzantine_clients is not None:
         fed = dataclasses.replace(fed,
                                   byzantine_clients=args.byzantine_clients)
+    if getattr(args, "init_weights", None) is not None:
+        fed = dataclasses.replace(fed, init_weights_npz=args.init_weights)
     run_kw = {}
     if args.checkpoint_dir is not None:
         run_kw["checkpoint_dir"] = args.checkpoint_dir
@@ -237,6 +235,18 @@ def main(argv=None) -> int:
                        help=">1 selects the 2-D ('clients','model') GSPMD "
                             "engine: hidden weights shard over a tensor-"
                             "parallel axis of this extent (MLP only)")
+    # run-only, like --aggregation: the sweep/parity programs have their
+    # own init and stop semantics; accepting these there would silently
+    # ignore them.
+    run_p.add_argument("--init-weights", default=None, metavar="NPZ",
+                       help="warm-start every client from a saved weights "
+                            "artifact (the sweep's --save-weights output); "
+                            "architecture must match")
+    run_p.add_argument("--pipelined-stop", action="store_true",
+                       help="overlap metric processing with the next "
+                            "chunk's device execution; stop decisions lag "
+                            "one chunk (the reference's stop signal has "
+                            "the same lag)")
     run_p.add_argument("--resume", action="store_true",
                        help="resume from the latest checkpoint in "
                             "--checkpoint-dir")

@@ -199,6 +199,12 @@ class FedConfig:
     # (FL_CustomMLP...:42). Set True to start all clients identical.
     same_init: bool = False
     init_seed: int = 0
+    # Warm-start every client from a saved weights artifact (the .npz the
+    # sweep writes via --save-weights / save_best_weights). The reference
+    # only PRINTS its grid winner (hyperparameters_tuning.py:130-132);
+    # this closes the loop: sweep -> persist -> train from the winner.
+    # Architecture must match; optimizer state starts fresh.
+    init_weights_npz: Optional[str] = None
     # The reference's stop signal takes effect one round late (:132 vs :195,
     # SURVEY.md §5 'race detection'). fedtpu stops immediately; no flag to
     # reproduce the lag — it is a bug, not behavior.

@@ -230,6 +230,27 @@ def build_experiment(cfg: ExperimentConfig,
     }
     state = state_fn()
 
+    if cfg.fed.init_weights_npz:
+        # Warm start from a persisted weights artifact (sweep winner):
+        # broadcast the loaded global model into every client slot,
+        # preserving each leaf's live sharding (works for both engines and
+        # under jax.distributed — same host data on every process).
+        from fedtpu.sweep.grid import load_best_weights
+        loaded = load_best_weights(cfg.fed.init_weights_npz)["weights"]
+        live = state["params"]
+        l_leaves = jax.tree.leaves(loaded)
+        p_leaves = jax.tree.leaves(live)
+        shapes_ok = (jax.tree.structure(loaded) == jax.tree.structure(live)
+                     and all(tuple(a.shape) == tuple(b.shape[1:])
+                             for a, b in zip(l_leaves, p_leaves)))
+        if not shapes_ok:
+            raise ValueError(
+                f"init_weights_npz architecture mismatch: artifact leaves "
+                f"{[tuple(a.shape) for a in l_leaves]} vs model (per-client) "
+                f"{[tuple(b.shape[1:]) for b in p_leaves]} — the artifact "
+                "was saved for a different hidden_sizes/input_dim")
+        state["params"] = _bcast_into_slots(loaded, live)
+
     # Opt-in Pallas fused forward for the held-out eval (a plain jit, outside
     # shard_map; the in-round eval stays on the XLA path, which shard_map's
     # scan requires in interpret mode).
@@ -258,6 +279,19 @@ def _tree_finite(tree) -> jax.Array:
     checks = [jnp.all(jnp.isfinite(l)) for l in jax.tree.leaves(tree)
               if jnp.issubdtype(l.dtype, jnp.inexact)]
     return jnp.all(jnp.stack(checks)) if checks else jnp.array(True)
+
+
+def _bcast_into_slots(global_np, live_params):
+    """Host-side form of bcast_global (fedtpu.parallel.round): one global
+    (clients-free) numpy pytree into every client slot of the live sharded
+    params, preserving each leaf's per-leaf sharding and dtype. Shared by
+    elastic resume and the init_weights warm start — keep them from
+    drifting apart."""
+    return jax.tree.map(
+        lambda g, p: jax.device_put(
+            np.broadcast_to(np.asarray(g)[None], p.shape).astype(p.dtype),
+            p.sharding),
+        global_np, live_params)
 
 
 def _unstack_metrics(metrics: dict, take: int) -> List[dict]:
@@ -346,11 +380,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # federation starts with).
                 g = jax.tree.map(lambda a: np.asarray(a).mean(axis=0),
                                  raw["params"])
-                state["params"] = jax.tree.map(
-                    lambda gl, p: jax.device_put(
-                        np.broadcast_to(gl[None], p.shape).astype(p.dtype),
-                        p.sharding),
-                    g, state["params"])
+                state["params"] = _bcast_into_slots(g, state["params"])
                 if ("server_opt_state" in raw
                         and "server_opt_state" in state):
                     state["server_opt_state"] = jax.tree.map(

@@ -104,3 +104,62 @@ def test_cli_sweep_saves_weights(tmp_path):
     assert tuple(loaded["params"]["hidden_layer_sizes"]) == (8,)
     assert loaded["params"]["learning_rate"] == 0.01
     assert len(loaded["weights"]["layers"]) == 2   # one hidden + head
+
+
+def test_run_warm_starts_from_sweep_winner(tmp_path):
+    # Closes the reference's dangling artifact loop: the sweep persists
+    # the winner (hyperparameters_tuning.py only prints it), and a run can
+    # START from it. On this easy synthetic set the winner is near-perfect,
+    # so round 1 of the warm-started run must already sit far above a
+    # fresh-init round 1.
+    import dataclasses
+    from fedtpu.config import FedConfig, ModelConfig, RunConfig
+    from fedtpu.orchestration.loop import run_experiment
+    from fedtpu.sweep.grid import save_best_weights
+
+    cfg = _cfg()
+    ds = load_tabular_dataset(cfg.data)
+    best = run_grid_search(cfg, dataset=ds, hidden_grid=((8,),),
+                           lr_grid=(0.05,), local_steps=60,
+                           keep_weights=True, verbose=False)
+    path = str(tmp_path / "winner.npz")
+    save_best_weights(path, best)
+    assert best["accuracy"] > 0.9
+
+    run_cfg = dataclasses.replace(
+        cfg,
+        model=ModelConfig(input_dim=ds.input_dim, hidden_sizes=(8,)),
+        fed=FedConfig(rounds=1, tolerance=0.0),
+        run=RunConfig(rounds_per_step=1))
+    fresh = run_experiment(run_cfg, dataset=ds, verbose=False)
+    warm = run_experiment(
+        dataclasses.replace(run_cfg, fed=dataclasses.replace(
+            run_cfg.fed, init_weights_npz=path)),
+        dataset=ds, verbose=False)
+    assert warm.global_metrics["accuracy"][0] > 0.85
+    assert (warm.global_metrics["accuracy"][0]
+            > fresh.global_metrics["accuracy"][0] + 0.2)
+
+
+def test_init_weights_architecture_mismatch_fails_fast(tmp_path):
+    import dataclasses
+    import pytest
+    from fedtpu.config import FedConfig, ModelConfig, RunConfig
+    from fedtpu.orchestration.loop import build_experiment
+    from fedtpu.sweep.grid import save_best_weights
+
+    cfg = _cfg()
+    ds = load_tabular_dataset(cfg.data)
+    best = run_grid_search(cfg, dataset=ds, hidden_grid=((8,),),
+                           lr_grid=(0.05,), local_steps=5,
+                           keep_weights=True, verbose=False)
+    path = str(tmp_path / "winner.npz")
+    save_best_weights(path, best)
+
+    bad = dataclasses.replace(
+        cfg,
+        model=ModelConfig(input_dim=ds.input_dim, hidden_sizes=(16, 16)),
+        fed=FedConfig(rounds=1, init_weights_npz=path),
+        run=RunConfig())
+    with pytest.raises(ValueError, match="architecture mismatch"):
+        build_experiment(bad, dataset=ds)
