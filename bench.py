@@ -227,14 +227,15 @@ def bench_reference_equivalent(ds) -> dict:
 
     one_round()  # warmup
     reps = 5
-    tc, ts = 0.0, 0.0
-    for _ in range(reps):
-        a, b = one_round()
-        tc += a
-        ts += b
-    tc, ts = tc / reps, ts / reps
+    rounds = [one_round() for _ in range(reps)]
     # mpirun runs ranks concurrently: ideal-parallel compute, serial comm.
     parallel = min(NUM_CLIENTS, os.cpu_count() or 1)
+    # Min over reps, not mean: transient load on this shared box inflates
+    # the baseline and would overstate OUR speedup — take the reference's
+    # least-contended (fastest) showing of the REPORTED metric (the
+    # parallel-credited sum, not raw tc+ts, which could pick a rep whose
+    # reported value is actually slower on a multi-core box).
+    tc, ts = min(rounds, key=lambda r: r[0] / parallel + r[1])
     return {"sec_per_round": tc / parallel + ts,
             "compute_s": tc, "serial_s": ts, "assumed_parallelism": parallel}
 
