@@ -203,7 +203,9 @@ class FedConfig:
     # sweep writes via --save-weights / save_best_weights). The reference
     # only PRINTS its grid winner (hyperparameters_tuning.py:130-132);
     # this closes the loop: sweep -> persist -> train from the winner.
-    # Architecture must match; optimizer state starts fresh.
+    # Architecture must match; optimizer state starts fresh. When a resume
+    # also applies, the checkpoint restores AFTER (and therefore over) the
+    # warm start — resume continues the run, warm start only seeds new ones.
     init_weights_npz: Optional[str] = None
     # The reference's stop signal takes effect one round late (:132 vs :195,
     # SURVEY.md §5 'race detection'). fedtpu stops immediately; no flag to

@@ -163,3 +163,42 @@ def test_init_weights_architecture_mismatch_fails_fast(tmp_path):
         run=RunConfig())
     with pytest.raises(ValueError, match="architecture mismatch"):
         build_experiment(bad, dataset=ds)
+
+
+def test_resume_takes_precedence_over_init_weights(tmp_path):
+    # A checkpointed run restarted with BOTH --resume and --init-weights
+    # must continue from the checkpoint, not restart from the artifact:
+    # warm start seeds a NEW experiment; resume restores a live one.
+    import dataclasses
+    from fedtpu.config import FedConfig, ModelConfig, RunConfig
+    from fedtpu.orchestration.loop import run_experiment
+    from fedtpu.sweep.grid import save_best_weights
+
+    cfg = _cfg()
+    ds = load_tabular_dataset(cfg.data)
+    best = run_grid_search(cfg, dataset=ds, hidden_grid=((8,),),
+                           lr_grid=(0.05,), local_steps=5,
+                           keep_weights=True, verbose=False)
+    path = str(tmp_path / "winner.npz")
+    save_best_weights(path, best)
+
+    ck = str(tmp_path / "ck")
+    run_cfg = dataclasses.replace(
+        cfg,
+        model=ModelConfig(input_dim=ds.input_dim, hidden_sizes=(8,)),
+        fed=FedConfig(rounds=3, tolerance=0.0),
+        run=RunConfig(rounds_per_step=1, checkpoint_dir=ck,
+                      checkpoint_every=1))
+    first = run_experiment(run_cfg, dataset=ds, verbose=False)
+    assert first.rounds_run == 3
+
+    both = dataclasses.replace(
+        run_cfg, fed=dataclasses.replace(run_cfg.fed, rounds=5,
+                                         init_weights_npz=path))
+    resumed = run_experiment(both, dataset=ds, verbose=False, resume=True)
+    # Continued 4..5 from the checkpoint (history restored + 2 new rounds),
+    # not a fresh 5-round warm-started run.
+    assert resumed.rounds_run == 5
+    assert len(resumed.global_metrics["accuracy"]) == 5
+    np.testing.assert_allclose(resumed.global_metrics["accuracy"][:3],
+                               first.global_metrics["accuracy"], atol=1e-6)
