@@ -9,7 +9,7 @@ import jax
 import numpy as np
 
 from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
-                           ModelConfig, RunConfig, ShardConfig)
+                           RunConfig, ShardConfig)
 from fedtpu.data.cifar10 import load_cifar10, synthetic_cifar_like
 from fedtpu.data.sharding import pack_clients
 from fedtpu.orchestration.loop import run_experiment

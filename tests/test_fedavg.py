@@ -4,7 +4,6 @@ optimizer-state-not-averaged invariant (SURVEY.md §7 'hard parts')."""
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 
 from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
 from fedtpu.data.sharding import pack_clients

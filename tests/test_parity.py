@@ -3,7 +3,6 @@ fit() re-initializes, so averaging has no effect — and the fedtpu path does
 not share the limitation."""
 
 import numpy as np
-import pytest
 
 from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
                            ModelConfig, ShardConfig)

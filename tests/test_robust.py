@@ -5,7 +5,6 @@ rank can move arbitrarily far."""
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 import pytest
 
 from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
@@ -15,7 +14,6 @@ from fedtpu.models import build_model
 from fedtpu.ops import build_optimizer
 from fedtpu.parallel import make_mesh, client_sharding
 from fedtpu.parallel.round import build_round_fn, init_federated_state
-from fedtpu.utils.trees import clone
 
 
 def _setup(num_clients=8, rows=200, lr=0.004, **round_kw):

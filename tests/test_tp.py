@@ -5,7 +5,6 @@ genuinely sharded over the tensor-parallel axis."""
 import dataclasses
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
