@@ -119,6 +119,10 @@ def _add_common_overrides(p: argparse.ArgumentParser):
     p.add_argument("--eval-test-every", type=int, default=None)
     p.add_argument("--rounds-per-step", type=int, default=None,
                    help="rounds scanned per compiled step (throughput knob)")
+    p.add_argument("--compilation-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache: repeat "
+                        "invocations skip the (tens of seconds) compiles. "
+                        "Also honored via JAX_COMPILATION_CACHE_DIR.")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of the round loop here")
     p.add_argument("--metrics-jsonl", default=None,
@@ -287,6 +291,20 @@ def main(argv=None) -> int:
                   f"model={preset.model.kind}{list(preset.model.hidden_sizes)} "
                   f"rounds={preset.fed.rounds} weighting={preset.fed.weighting}")
         return 0
+
+    if getattr(args, "compilation_cache", None):
+        # Before any compile: every subcommand's first jit lands in (or is
+        # served from) the on-disk cache across CLI invocations.
+        import os as _os
+
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          _os.path.abspath(args.compilation_cache))
+        # Lower JAX's 1.0 s threshold so the seconds-scale round programs
+        # all qualify — but never clobber an explicit user setting.
+        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in _os.environ:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.5)
 
     cfg = _apply_overrides(get_preset(args.preset), args)
 

@@ -127,3 +127,26 @@ def test_run_compress_end_to_end_via_cli(capsys):
     assert rc == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["rounds_run"] == 2
+
+
+def test_compilation_cache_flag_populates_cache(tmp_path):
+    # --compilation-cache must be applied BEFORE any compile, so repeat CLI
+    # invocations serve their XLA executables from disk. Subprocesses: the
+    # cache config is process-global.
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cache = tmp_path / "xlacache"
+    cmd = [sys.executable, "-m", "fedtpu.cli", "run", "--csv", "",
+           "--num-clients", "2", "--hidden-sizes", "8", "--rounds", "1",
+           "--compilation-cache", str(cache), "--quiet", "--json"]
+    # Threshold 0: cache even the tiny CPU test program deterministically
+    # (the CLI respects the env var and must not clobber it).
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0")
+    r = subprocess.run(cmd, cwd=repo, env=env, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert cache.is_dir() and len(list(cache.iterdir())) > 0
