@@ -564,9 +564,10 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         #     matches the synchronous run exactly) but the final state
         #     carries its training. The reference's own stop-signal bcast
         #     has the same one-step lag (FL_CustomMLP...:132 vs :195).
-        #   * the chunk-end STATE finiteness gate is skipped between chunks
-        #     (fetching the in-flight state would serialize every chunk —
-        #     the exact cost this mode removes) and runs once at loop exit;
+        #   * the chunk-end STATE finiteness gate runs only at checkpoint /
+        #     held-out-eval boundaries (which sync inherently) and at loop
+        #     exit — fetching the in-flight state between ordinary chunks
+        #     would serialize every chunk, the exact cost this mode removes;
         #     the per-round METRIC guard still runs every round, one chunk
         #     late.
         # Checkpoint / held-out-eval boundaries force their inherent sync
@@ -596,24 +597,6 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 pending = None
                 break
 
-            # Chunk-end state check: metrics can stay finite for one round
-            # AFTER params go NaN (argmax over NaN logits yields index 0, and
-            # the reported loss is computed at pre-update params), and Adam
-            # moments can overflow while params are still finite — so the
-            # per-round metric guard above would let a periodic checkpoint
-            # capture a poisoned state as "good". Gate the checkpoint on the
-            # actual full state (params + optimizer moments). Skipped
-            # per-chunk in pipelined mode (it would force a sync every
-            # chunk); runs at loop exit instead.
-            if (not pipelined) and cfg.run.halt_on_nonfinite and not bool(
-                    _tree_finite(
-                        {k: state[k] for k in
-                         ("params", "opt_state", "server_opt_state")
-                         if k in state})):
-                halt_diverged(f"params/optimizer state after round {rnd}",
-                              rnd)
-                break
-
             # Held-out eval / checkpoint at chunk boundaries when due within the
             # chunk (with rounds_per_step=1 this is the exact per-round cadence).
             # Every due round appends an entry so test_hist round-alignment
@@ -632,6 +615,30 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 pending = None
                 if stopped_early:
                     break
+
+            # Chunk-end state check: metrics can stay finite for one round
+            # AFTER params go NaN (argmax over NaN logits yields index 0, and
+            # the reported loss is computed at pre-update params), and Adam
+            # moments can overflow while params are still finite — so the
+            # per-round metric guard above would let a periodic checkpoint
+            # capture a poisoned state as "good". Gate the checkpoint on the
+            # actual full state (params + optimizer moments). In pipelined
+            # mode the per-chunk check would force a sync every chunk — the
+            # exact cost the mode removes — so it runs only at checkpoint /
+            # held-out-eval boundaries (which already sync inherently; the
+            # gate adds no extra serialization) and once at loop exit. A
+            # periodic save therefore NEVER persists a poisoned state as the
+            # latest good checkpoint, and held-out eval never runs on NaN
+            # params, in either mode.
+            if cfg.run.halt_on_nonfinite \
+                    and (not pipelined or ckpt_due or eval_due) \
+                    and not bool(_tree_finite(
+                        {k: state[k] for k in
+                         ("params", "opt_state", "server_opt_state")
+                         if k in state})):
+                halt_diverged(f"params/optimizer state after round {rnd}",
+                              rnd)
+                break
 
             if eval_due:
                 # _rep: the global slice of a client-sharded array is not
@@ -660,15 +667,24 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
 
         if pending is not None and not stopped_early:
             process_chunk(*pending, state_round=rnd)
-        if pipelined and not diverged and cfg.run.halt_on_nonfinite and (
+        if (pipelined or stopped_early) and not diverged \
+                and cfg.run.halt_on_nonfinite and (
                 not bool(_tree_finite(
                     {k: state[k] for k in
                      ("params", "opt_state", "server_opt_state")
                      if k in state}))):
-            # The deferred state gate (see above) — label is the last
-            # completed round.
-            halt_diverged(f"params/optimizer state after round {rounds_run}",
-                          rounds_run)
+            # The deferred state gate (see above) — in pipelined mode the
+            # only between-boundary state check; in sync mode only after an
+            # early-stop break, the one path the in-loop gate misses (its
+            # final chunk may poison the state while pre-update metrics
+            # stay finite). A healthy sync completion skips it: the in-loop
+            # gate already checked the final chunk, and the re-check would
+            # cost a redundant fetch RTT. Label with `rnd` — the
+            # round the CURRENT state corresponds to — not rounds_run: after
+            # an early stop the state carries the overshoot chunk's training
+            # (up to one chunk past rounds_run), and halt_diverged's
+            # contract is label == saved state.
+            halt_diverged(f"params/optimizer state after round {rnd}", rnd)
 
     finally:
         if cfg.run.profile_dir:
