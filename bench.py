@@ -112,12 +112,25 @@ def bench_fedtpu(ds) -> dict:
         # fetch at the end (the fixed-rounds production shape — run N
         # chunks, read results at the end). Dispatch overlaps compute.
         # timed_rounds is the mandatory harness: fetch-forced window +
-        # flops-floor check.
+        # flops-floor check. Several independent windows per rps: dispatch
+        # jitter on the tunneled transport is ~±15%, and recording a single
+        # window lets the artifact quote the top of its own jitter band
+        # (review r2) — report the median and keep the band.
         n_calls = max(3, min(20, 2000 // rps))
-        sec_per_round, state, metrics = timed_rounds(
-            step, state, batch, n_calls, rps, peak, flops_per_round,
-            label=f"rps={rps}")
+        reps = 5 if rps == HEADLINE_RPS else 1
+        samples = []
+        for _ in range(reps):
+            sec_rep, state, metrics = timed_rounds(
+                step, state, batch, n_calls, rps, peak, flops_per_round,
+                label=f"rps={rps}")
+            samples.append(sec_rep)
+        sec_per_round = float(np.median(samples))
         acc = float(np.asarray(metrics["client_mean"]["accuracy"]).ravel()[-1])
+        # The rounds the accuracy is attributed to must count EVERYTHING
+        # the state trained through — warmup calls and all timed windows
+        # across all reps — not just one window's n_calls * rps. The
+        # state's own round counter is the exact ledger.
+        rounds_trained = int(np.asarray(state["round"]))
 
         # SYNCHRONOUS latency: fetch the metrics after every call — the
         # early-stopping production loop's shape (host inspects metrics at
@@ -134,9 +147,15 @@ def bench_fedtpu(ds) -> dict:
         assert_above_flops_floor(sec_sync, flops_per_round, peak,
                                  label=f"rps={rps} sync")
         sweep[rps] = {"sec_per_round": sec_per_round,
+                      "sec_per_round_range": [float(min(samples)),
+                                              float(max(samples))],
                       "sec_per_round_sync": sec_sync,
                       "rounds_timed": n_calls * rps,
+                      "rounds_trained": rounds_trained,
                       "floor_sec": floor,
+                      # Model FLOPs utilization at this rps: fraction of the
+                      # measured device peak the timed program sustains.
+                      "mfu": flops_per_round / (sec_per_round * peak),
                       "final_accuracy": acc}
 
     head = sweep[HEADLINE_RPS]
@@ -145,9 +164,10 @@ def bench_fedtpu(ds) -> dict:
     if head["final_accuracy"] < 0.75:
         raise RuntimeError(
             f"benchmark program is not actually training: accuracy "
-            f"{head['final_accuracy']:.3f} after {head['rounds_timed']} "
+            f"{head['final_accuracy']:.3f} after {head['rounds_trained']} "
             "rounds (expected ~0.83)")
     return {"sec_per_round": head["sec_per_round"],
+            "sec_per_round_range": head["sec_per_round_range"],
             "sec_per_round_sync": head["sec_per_round_sync"],
             "rounds_per_step": HEADLINE_RPS,
             "accuracy": head["final_accuracy"],
@@ -155,6 +175,7 @@ def bench_fedtpu(ds) -> dict:
             "backend": dev.platform,
             "peak_flops_measured": peak,
             "flops_per_round": flops_per_round,
+            "mfu": head["mfu"],
             "sweep": sweep}
 
 
@@ -244,33 +265,62 @@ def main():
     ds = _dataset()
     ours = bench_fedtpu(ds)
     base = bench_reference_equivalent(ds)
+    lo, hi = ours["sec_per_round_range"]
+    g3 = lambda v: float(f"{v:.3g}")
     result = {
         "metric": "sec_per_round_fedavg8_income_mlp",
         # 3 significant figures — the value sits at sub-millisecond scale
-        # where fixed decimals would destroy it.
-        "value": float(f"{ours['sec_per_round']:.3g}"),
+        # where fixed decimals would destroy it. The headline is the MEDIAN
+        # of 5 independent timed windows; vs_baseline_range is the full
+        # window band, so the single number can never travel without its
+        # jitter (review r2).
+        "value": g3(ours["sec_per_round"]),
         "unit": "s",
         "vs_baseline": float(
             f"{base['sec_per_round'] / ours['sec_per_round']:.4g}"),
+        "vs_baseline_range": [g3(base["sec_per_round"] / hi),
+                              g3(base["sec_per_round"] / lo)],
+        "mfu": g3(ours["mfu"]),
+        "sweep": {str(rps): {"pipelined_s": g3(row["sec_per_round"]),
+                             "sync_s": g3(row["sec_per_round_sync"]),
+                             "mfu": g3(row["mfu"])}
+                  for rps, row in ours["sweep"].items()},
+        "baseline": {
+            "sec_per_round": g3(base["sec_per_round"]),
+            "assumed_parallelism": base["assumed_parallelism"],
+            # The parallel-credit caveat must ride IN the artifact: the
+            # baseline's compute term is divided by min(8, cpu_count).
+            # On this 1-core box that credit is 1; on an 8-core host the
+            # reference's compute shrinks up to 8x and the quoted speedup
+            # drops accordingly (see vs_baseline_if_8cores).
+            "vs_baseline_if_8cores": g3(
+                (base["compute_s"] / 8 + base["serial_s"])
+                / ours["sec_per_round"]),
+        },
     }
     print(json.dumps(result))
     # Detail lines on stderr so stdout stays one JSON line.
     print(f"[bench] headline (rps={HEADLINE_RPS}, pipelined): "
           f"{ours['sec_per_round']:.3e} s/round "
-          f"(synchronous {ours['sec_per_round_sync']:.3e}), "
+          f"(window band [{lo:.3e}, {hi:.3e}]; "
+          f"synchronous {ours['sec_per_round_sync']:.3e}), "
           f"accuracy {ours['accuracy']:.4f}, devices {ours['devices']}, "
           f"backend {ours['backend']}, measured peak "
           f"{ours['peak_flops_measured'] / 1e12:.1f} TFLOP/s, "
-          f"{ours['flops_per_round']:.2e} FLOPs/round",
+          f"{ours['flops_per_round']:.2e} FLOPs/round, "
+          f"MFU {100 * ours['mfu']:.1f}%",
           file=sys.stderr)
     for rps, row in ours["sweep"].items():
         print(f"[bench] rps={rps:>4}: pipelined "
               f"{row['sec_per_round']:.3e} s/round, sync "
               f"{row['sec_per_round_sync']:.3e} s/round "
               f"(floor {row['floor_sec']:.3e}, "
-              f"{row['rounds_timed']} rounds timed)", file=sys.stderr)
-    print(f"[bench] baseline(measured reference-equivalent): {base}",
-          file=sys.stderr)
+              f"MFU {100 * row['mfu']:.1f}%, "
+              f"{row['rounds_timed']} rounds/window, "
+              f"{row['rounds_trained']} trained)", file=sys.stderr)
+    print(f"[bench] baseline(measured reference-equivalent): {base} — "
+          "compute credited /min(8, cpu_count); an 8-core host shrinks "
+          "the baseline and the speedup accordingly", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -53,6 +53,14 @@ def _nonnegative_float(text: str) -> float:
     return value
 
 
+def _open_unit_float(text: str) -> float:
+    value = float(text)
+    if not 0.0 < value < 1.0:
+        raise argparse.ArgumentTypeError(
+            f"must be in the open interval (0, 1), got {value}")
+    return value
+
+
 def _add_common_overrides(p: argparse.ArgumentParser):
     p.add_argument("--preset", default="income-8", choices=sorted(PRESETS))
     p.add_argument("--csv", default=None, help="dataset CSV path")
@@ -89,6 +97,12 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    default=None,
                    help="Gaussian noise multiplier on the averaged clipped "
                         "delta (needs --dp-clip-norm > 0)")
+    p.add_argument("--dp-delta", type=_open_unit_float, default=None,
+                   help="target delta for the (epsilon, delta) report the "
+                        "RDP accountant adds to the summary when DP noise "
+                        "is on (default 1e-5; pick << 1/num_clients; "
+                        "rejected at parse time outside (0, 1) — the "
+                        "accountant would refuse it after the whole run)")
     p.add_argument("--compress", choices=["none", "int8"], default=None,
                    help="int8-quantize the update exchange (D/8 of the f32 "
                         "psum traffic at D devices; for few-host DCN-bound "
@@ -177,6 +191,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         fed = dataclasses.replace(fed, server_momentum=args.server_momentum)
     if args.dp_clip_norm is not None:
         fed = dataclasses.replace(fed, dp_clip_norm=args.dp_clip_norm)
+    if args.dp_delta is not None:
+        fed = dataclasses.replace(fed, dp_delta=args.dp_delta)
     if args.dp_noise_multiplier is not None:
         fed = dataclasses.replace(fed,
                                   dp_noise_multiplier=args.dp_noise_multiplier)
@@ -276,6 +292,13 @@ def main(argv=None) -> int:
                               "weights + hyperparameters + metrics as an "
                               ".npz (the reference only prints them, "
                               "hyperparameters_tuning.py:130-132)")
+    sweep_p.add_argument("--plateau-stop", action="store_true",
+                         help="sklearn-faithful local fits: treat the step "
+                              "budget as a cap and stop each (client, lr) "
+                              "fit once its loss plateaus (tol 1e-4, 10 "
+                              "epochs — MLPClassifier's early stop, which "
+                              "the reference's max_iter=400 grid runs "
+                              "under, hyperparameters_tuning.py:90)")
 
     parity_p = sub.add_parser("parity",
                               help="sklearn warm-start limitation demo")
@@ -338,6 +361,7 @@ def main(argv=None) -> int:
                    if args.local_steps is not None else {}),
                 **grid_kw,
                 keep_weights=bool(args.save_weights),
+                plateau_stop=args.plateau_stop,
                 verbose=not args.quiet)
             if table_f is not None:
                 for row in summary["table"]:

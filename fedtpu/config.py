@@ -168,6 +168,11 @@ class FedConfig:
     dp_clip_norm: float = 0.0
     dp_noise_multiplier: float = 0.0
     dp_seed: int = 0
+    # Target delta for the RDP accountant's (epsilon, delta) report
+    # (fedtpu.ops.dp_accountant; surfaced in the run summary whenever DP
+    # noise is on). Pick delta << 1/num_clients for a meaningful client-
+    # level guarantee.
+    dp_delta: float = 1e-5
     # Byzantine-robust aggregation: 'none' (weighted mean — the reference's
     # rule) | 'median' (coordinate-wise) | 'trimmed_mean' (drop trim_ratio
     # from each end per coordinate) | 'krum' (select the single client

@@ -49,33 +49,81 @@ HIDDEN_GRID = ((50,), (100,), (50, 50), (100, 50), (50, 100), (50, 200),
 LR_GRID = (0.002, 0.005, 0.004, 0.008, 0.01, 0.02, 0.05, 0.1, 0.2)
 
 
-def _build_sweep_fn(mesh, num_classes: int, local_steps: int, optim_cfg):
-    """One compiled program: train every (lr, client) pair for ``local_steps``
-    full-batch steps, then uniform-average over clients per lr.
+def _build_sweep_fn(mesh, num_classes: int, local_steps: int, optim_cfg,
+                    plateau_stop: bool = False, tol: float = 1e-4,
+                    n_iter_no_change: int = 10):
+    """One compiled program: train every (lr, client) pair for up to
+    ``local_steps`` full-batch steps, then uniform-average over clients
+    per lr.
 
     Array layout: params/opt_state leaves are (C, L, ...) — clients leading
     (sharded over the mesh), learning rates dense per device.
+
+    ``plateau_stop`` reproduces the sklearn semantics the reference's grid
+    actually runs under: ``MLPClassifier(max_iter=400)``'s 400 is a CAP,
+    not a count — the adam solver stops early once the loss fails to
+    improve by more than ``tol`` for ``n_iter_no_change`` consecutive
+    epochs (sklearn defaults 1e-4 / 10; the bookkeeping below mirrors
+    ``_update_no_improvement_count``: best_loss starts at +inf, the
+    counter resets on improvement, training stops once it EXCEEDS
+    ``n_iter_no_change``). Under jit this is a ``where``-gated freeze
+    inside the same fixed-length scan — stopped (lr, client) pairs coast
+    as no-ops, so the compiled shape stays static and the lr axis stays
+    vmappable even though each pair stops at its own step. Off by
+    default: the fixed-step trainer is the documented fedtpu semantics;
+    the flag exists to measure the reference-faithful winner
+    (hyperparameters_tuning.py:90).
     """
     base = optax.scale_by_adam(b1=optim_cfg.b1, b2=optim_cfg.b2,
                                eps=optim_cfg.eps, eps_root=0.0)
 
     def train_one(params, opt_state, lr, x, y, mask):
+        def loss_fn(q):
+            return masked_cross_entropy(mlp_apply(q, x), y, mask)
+
         def step(carry, _):
-            p, s = carry
+            p, s, best, no_imp, active, steps = carry
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            updates, s_new = base.update(grads, s)
+            p_new = jax.tree.map(lambda a, u: a - lr * u, p, updates)
+            # Epoch runs only while active; a stopped pair's whole carry
+            # freezes (params, moments, and the plateau bookkeeping).
+            keep = lambda new, old: jax.tree.map(
+                lambda a, b: jnp.where(active, a, b), new, old)
+            p, s = keep(p_new, p), keep(s_new, s)
+            worse = loss > best - tol
+            no_imp = jnp.where(active,
+                               jnp.where(worse, no_imp + 1, 0), no_imp)
+            best = jnp.where(active, jnp.minimum(best, loss), best)
+            steps = steps + active.astype(jnp.int32)
+            active = active & (no_imp <= n_iter_no_change)
+            return (p, s, best, no_imp, active, steps), None
 
-            def loss_fn(q):
-                return masked_cross_entropy(mlp_apply(q, x), y, mask)
+        if plateau_stop:
+            # The bookkeeping scalars must enter the scan carry already
+            # marked clients-varying (the loss they get compared to is
+            # computed from the client's shard), or shard_map rejects the
+            # carry as unvarying-in / varying-out.
+            vary = lambda v: jax.lax.pcast(v, CLIENTS_AXIS, to="varying")
+            init = (params, opt_state, vary(jnp.float32(jnp.inf)),
+                    vary(jnp.int32(0)), vary(jnp.bool_(True)),
+                    vary(jnp.int32(0)))
+            (params, opt_state, _, _, _, steps), _ = jax.lax.scan(
+                step, init, length=local_steps)
+        else:
+            def fixed_step(carry, _):
+                p, s = carry
+                grads = jax.grad(loss_fn)(p)
+                updates, s = base.update(grads, s)
+                p = jax.tree.map(lambda a, u: a - lr * u, p, updates)
+                return (p, s), None
 
-            grads = jax.grad(loss_fn)(p)
-            updates, s = base.update(grads, s)
-            p = jax.tree.map(lambda a, u: a - lr * u, p, updates)
-            return (p, s), None
-
-        (params, opt_state), _ = jax.lax.scan(step, (params, opt_state),
-                                              length=local_steps)
+            (params, opt_state), _ = jax.lax.scan(
+                fixed_step, (params, opt_state), length=local_steps)
+            steps = jnp.int32(local_steps)
         preds = jnp.argmax(mlp_apply(params, x), axis=-1)
         conf = confusion_matrix(y, preds, mask, num_classes)
-        return params, conf
+        return params, conf, steps
 
     def body(params, opt_state, lrs, x, y, mask):
         # params: (Cb, L, ...), lrs: (L,) replicated, x/y/mask: (Cb, N, ...)
@@ -83,20 +131,25 @@ def _build_sweep_fn(mesh, num_classes: int, local_steps: int, optim_cfg):
                            in_axes=(0, 0, 0, None, None, None))
         over_clients = jax.vmap(over_lr,
                                 in_axes=(0, 0, None, 0, 0, 0))
-        params, conf = over_clients(params, opt_state, lrs, x, y, mask)
+        params, conf, steps = over_clients(params, opt_state, lrs,
+                                           x, y, mask)
         # Uniform mean over ALL clients per lr (hyperparameters_tuning.py:37).
         num_clients = jax.lax.psum(jnp.float32(x.shape[0]), CLIENTS_AXIS)
         avg_params = jax.tree.map(
             lambda p: jax.lax.psum(p.sum(axis=0), CLIENTS_AXIS) / num_clients,
             params)                               # (L, ...)
         pooled_conf = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)  # (L, K, K)
-        return avg_params, conf, pooled_conf
+        # Mean steps actually run per lr (every client fitted local_steps
+        # in fixed mode; own plateau point each in plateau mode).
+        mean_steps = (jax.lax.psum(steps.sum(axis=0).astype(jnp.float32),
+                                   CLIENTS_AXIS) / num_clients)  # (L,)
+        return avg_params, conf, pooled_conf, mean_steps
 
     spec_c = P(CLIENTS_AXIS)
     return jax.jit(jax.shard_map(
         body, mesh=mesh,
         in_specs=(spec_c, spec_c, P(), spec_c, spec_c, spec_c),
-        out_specs=(P(), spec_c, P()),
+        out_specs=(P(), spec_c, P(), P()),
     ))
 
 
@@ -104,6 +157,7 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     hidden_grid=None, lr_grid=None,
                     local_steps: int = 400, vmap_lr: bool = True,
                     keep_weights: bool = False,
+                    plateau_stop: bool = False,
                     verbose: bool = True) -> dict:
     """Run the 90-config federated grid; returns the best-config summary
     (the reference's :126-132 printout, as data). ``hidden_grid``/``lr_grid``
@@ -112,7 +166,14 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     ``keep_weights=True`` retains the winning config's post-averaging
     weight pytree under ``best["weights"]`` (numpy leaves) — the artifact
     the reference prints to stdout at hyperparameters_tuning.py:130-132
-    (tracked at :115-119); pass it to ``save_best_weights`` to persist."""
+    (tracked at :115-119); pass it to ``save_best_weights`` to persist.
+
+    ``plateau_stop=True`` selects sklearn's early-stopping semantics for
+    the local fits (``max_iter`` as a cap with tol-1e-4 / 10-epoch plateau
+    detection — what ``MLPClassifier(max_iter=400)`` at
+    hyperparameters_tuning.py:90 actually does) instead of the fixed
+    ``local_steps`` count; each table row then carries the mean steps the
+    clients actually ran (``mean_local_steps``)."""
     hidden_grid = HIDDEN_GRID if hidden_grid is None else hidden_grid
     lr_grid = LR_GRID if lr_grid is None else lr_grid
     ds = dataset or load_dataset(cfg.data)
@@ -134,7 +195,7 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         # One compiled program per architecture (shapes differ across
         # ``hidden``); in the sequential path all 9 lr runs share it.
         sweep_fn = _build_sweep_fn(mesh, ds.num_classes, local_steps,
-                                   cfg.optim)
+                                   cfg.optim, plateau_stop=plateau_stop)
         for lr_group in lr_groups:
             l = len(lr_group)
             # Same-seed init per config == fresh random_state=42 model per
@@ -152,15 +213,18 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             opt_state = jax.tree.map(lambda p: jax.device_put(p, shard),
                                      opt_state)
             lrs = jnp.asarray(lr_group, jnp.float32)
-            avg_params, conf, pooled_conf = sweep_fn(params, opt_state, lrs,
-                                                     x, y, mask)
+            avg_params, conf, pooled_conf, mean_steps = sweep_fn(
+                params, opt_state, lrs, x, y, mask)
 
             pooled = jax.vmap(metrics_from_confusion)(pooled_conf)
             pooled = {k: np.asarray(v) for k, v in pooled.items()}
+            mean_steps = np.asarray(mean_steps)
             for i, lr in enumerate(lr_group):
                 metrics = {k: float(v[i]) for k, v in pooled.items()}
                 table.append({"hidden_layer_sizes": tuple(hidden),
-                              "learning_rate": float(lr), **metrics})
+                              "learning_rate": float(lr),
+                              "mean_local_steps": float(mean_steps[i]),
+                              **metrics})
                 if verbose:
                     print(f"  grid [{hidden} lr={lr}]: "
                           f"acc={metrics['accuracy']:.4f} "

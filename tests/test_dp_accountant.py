@@ -1,0 +1,101 @@
+"""RDP privacy accountant (fedtpu.ops.dp_accountant): pinned against a
+published value, the q=1 closed form, and monotonicity; plus the run
+summary wiring (VERDICT r2 weak #6 — a DP knob must output epsilon)."""
+
+import dataclasses
+import math
+
+import numpy as np
+import pytest
+
+from fedtpu.ops.dp_accountant import (closed_form_gaussian_epsilon,
+                                      privacy_spent, rdp_sampled_gaussian)
+
+
+def test_abadi_et_al_canonical_value():
+    # The canonical moments-accountant example (Abadi et al. 2016, §5;
+    # reproduced in TF-Privacy's tutorials): q=0.01, sigma=4, T=10000,
+    # delta=1e-5 -> epsilon ~= 1.26.
+    out = privacy_spent(q=0.01, noise_multiplier=4.0, steps=10000,
+                        delta=1e-5)
+    assert abs(out["epsilon"] - 1.26) < 0.03
+    assert out["order"] == 20
+
+
+def test_full_participation_matches_closed_form():
+    # q=1 is the plain Gaussian mechanism; minimizing the RDP-to-DP
+    # conversion over REAL orders has a closed form. Integer orders may
+    # only be slightly LOOSER (never tighter).
+    for sigma, steps in ((2.0, 100), (1.0, 10), (5.0, 1000)):
+        exact = closed_form_gaussian_epsilon(sigma, steps, 1e-5)
+        got = privacy_spent(q=1.0, noise_multiplier=sigma, steps=steps,
+                            delta=1e-5)["epsilon"]
+        assert exact <= got <= exact * 1.05
+
+
+def test_monotonicity():
+    base = dict(q=0.1, noise_multiplier=1.0, steps=100, delta=1e-5)
+    eps = privacy_spent(**base)["epsilon"]
+    assert privacy_spent(**{**base, "steps": 1000})["epsilon"] > eps
+    assert privacy_spent(**{**base, "noise_multiplier": 2.0})["epsilon"] < eps
+    assert privacy_spent(**{**base, "q": 0.5})["epsilon"] > eps
+    assert privacy_spent(**{**base, "delta": 1e-8})["epsilon"] > eps
+
+
+def test_edge_cases():
+    assert privacy_spent(0.1, 1.0, 0, 1e-5)["epsilon"] == 0.0
+    assert privacy_spent(0.0, 1.0, 100, 1e-5)["epsilon"] == 0.0
+    assert math.isinf(privacy_spent(0.1, 0.0, 100, 1e-5)["epsilon"])
+    # Subsampling amplifies: q<1 must be strictly cheaper than q=1.
+    full = rdp_sampled_gaussian(1.0, 1.0, 8)
+    sub = rdp_sampled_gaussian(0.1, 1.0, 8)
+    assert 0 < sub < full
+    with pytest.raises(ValueError):
+        rdp_sampled_gaussian(1.5, 1.0, 8)
+    with pytest.raises(ValueError):
+        privacy_spent(0.1, 1.0, 10, delta=0.0)
+
+
+def test_run_summary_reports_epsilon():
+    from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                               ShardConfig)
+    from fedtpu.orchestration.loop import run_experiment
+
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=128),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=3, weighting="uniform", dp_clip_norm=1.0,
+                      dp_noise_multiplier=1.0),
+    )
+    res = run_experiment(cfg, verbose=False)
+    dp = res.summary()["dp"]
+    assert dp["rounds"] == res.rounds_run == 3
+    assert dp["sampling_rate"] == 1.0 and dp["noise_multiplier"] == 1.0
+    expect = privacy_spent(1.0, 1.0, 3, cfg.fed.dp_delta)["epsilon"]
+    np.testing.assert_allclose(dp["epsilon"], expect)
+    assert 0 < dp["epsilon"] < 20
+
+    # Pipelined early stop: the released params carry the in-flight
+    # overshoot chunk's extra noised rounds — the accountant must count
+    # the state's trained rounds, never the shorter recorded history
+    # (under-reporting epsilon is the unsafe direction).
+    from fedtpu.config import RunConfig
+    over = dataclasses.replace(
+        cfg,
+        fed=dataclasses.replace(cfg.fed, rounds=30, tolerance=1.0,
+                                termination_patience=2,
+                                dp_noise_multiplier=1.0),
+        run=RunConfig(rounds_per_step=3, pipelined_stop=True))
+    res_o = run_experiment(over, verbose=False)
+    assert res_o.stopped_early
+    assert res_o.rounds_trained > res_o.rounds_run
+    dp_o = res_o.privacy_spent()
+    assert dp_o["rounds"] == res_o.rounds_trained
+    assert (dp_o["epsilon"]
+            > privacy_spent(1.0, 1.0, res_o.rounds_run, 1e-5)["epsilon"])
+
+    # Clip-only runs (no noise) must NOT claim an epsilon.
+    clip_only = dataclasses.replace(
+        cfg, fed=dataclasses.replace(cfg.fed, dp_noise_multiplier=0.0))
+    res2 = run_experiment(clip_only, verbose=False)
+    assert "dp" not in res2.summary()

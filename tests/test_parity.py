@@ -37,3 +37,22 @@ def test_full_demo_contrasts_both_paths():
     assert out["limitation_demonstrated"]
     assert out["fedtpu_uses_global_weights"]
     assert len(out["fedtpu"]["pooled_metrics"]["accuracy"]) == 3
+
+
+def test_final_global_weight_stats_reported():
+    # The reference's closing report (FL_SkLearn...:146-150): per-layer
+    # shape/mean/std of the final global weights — both paths must emit it.
+    cfg = _cfg()
+    out = run_parity_demo(cfg, sklearn_max_iter=25, verbose=False)
+    hidden = tuple(cfg.model.hidden_sizes)
+    n_layers = len(hidden) + 1
+    for side in ("sklearn", "fedtpu"):
+        stats = out[side]["global_weight_stats"]
+        # coefs then intercepts, one of each per layer.
+        assert len(stats) == 2 * n_layers
+        for st in stats:
+            assert set(st) == {"shape", "mean", "std"}
+            assert np.isfinite(st["mean"]) and np.isfinite(st["std"])
+    # The weight matrices' shapes must describe the actual architecture.
+    first = out["sklearn"]["global_weight_stats"][0]
+    assert first["shape"][1] == hidden[0]

@@ -202,3 +202,84 @@ def test_resume_takes_precedence_over_init_weights(tmp_path):
     assert len(resumed.global_metrics["accuracy"]) == 5
     np.testing.assert_allclose(resumed.global_metrics["accuracy"][:3],
                                first.global_metrics["accuracy"], atol=1e-6)
+
+
+# ------------------------------------------------- plateau-stop semantics
+
+def test_plateau_stop_freezes_exactly_at_the_plateau_point():
+    """Mechanism pin: with a huge tol every post-first step is 'no
+    improvement', so sklearn's bookkeeping (counter resets on improvement,
+    stop once it EXCEEDS n_iter_no_change) trains exactly
+    n_iter_no_change + 2 steps and then coasts — the result must equal a
+    fixed-step run of that length bit-for-bit."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from fedtpu.models.mlp import mlp_init
+    from fedtpu.parallel.mesh import client_sharding, make_mesh
+    from fedtpu.sweep.grid import _build_sweep_fn
+    from fedtpu.data.sharding import pack_clients
+
+    cfg = _cfg()
+    ds = load_tabular_dataset(cfg.data)
+    mesh = make_mesh(num_clients=8)
+    shard = client_sharding(mesh)
+    packed = pack_clients(ds.x_train, ds.y_train, cfg.shard)
+    x, y, mask = (jax.device_put(v, shard)
+                  for v in (packed.x, packed.y, packed.mask))
+
+    def inputs():
+        base = mlp_init(jax.random.key(42), ds.input_dim, (8,),
+                        ds.num_classes)
+        params = jax.tree.map(
+            lambda p: jnp.broadcast_to(p, (8, 1) + p.shape), base)
+        opt_state = jax.vmap(jax.vmap(
+            lambda p: optax.scale_by_adam(eps_root=0.0).init(p)))(params)
+        put = lambda t: jax.tree.map(
+            lambda p: jax.device_put(p, shard), t)
+        return put(params), put(opt_state)
+
+    lrs = jnp.asarray([0.01], jnp.float32)
+    # n_iter_no_change=2, tol=1e9: step 1 improves from inf (counter 0);
+    # steps 2-4 each fail the tol bar (counter 1,2,3); 3 > 2 stops after
+    # step 4.
+    plateau_fn = _build_sweep_fn(mesh, ds.num_classes, local_steps=20,
+                                 optim_cfg=cfg.optim, plateau_stop=True,
+                                 tol=1e9, n_iter_no_change=2)
+    p0, s0 = inputs()
+    avg_p, _, _, mean_steps = plateau_fn(p0, s0, lrs, x, y, mask)
+    assert float(np.asarray(mean_steps)[0]) == 4.0
+
+    fixed_fn = _build_sweep_fn(mesh, ds.num_classes, local_steps=4,
+                               optim_cfg=cfg.optim)
+    p1, s1 = inputs()
+    avg_p_fixed, _, _, fixed_steps = fixed_fn(p1, s1, lrs, x, y, mask)
+    assert float(np.asarray(fixed_steps)[0]) == 4.0
+    for a, b in zip(jax.tree.leaves(avg_p), jax.tree.leaves(avg_p_fixed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_plateau_stop_fires_before_the_cap_like_sklearn():
+    """The reference's grid runs under MLPClassifier(max_iter=400), where
+    400 is a CAP: sklearn's adam stops at the loss plateau. Demonstrate
+    the cap-vs-count distinction on real sklearn, then check fedtpu's
+    plateau trainer also stops early while the fixed trainer runs all
+    400 steps (VERDICT r2 missing #1)."""
+    from sklearn.neural_network import MLPClassifier
+
+    cfg = _cfg()
+    ds = load_tabular_dataset(cfg.data)
+    clf = MLPClassifier(hidden_layer_sizes=(8,), max_iter=400,
+                        random_state=42)
+    clf.fit(ds.x_train, ds.y_train)
+    assert clf.n_iter_ < 400  # max_iter is a cap, not a step count
+
+    res = run_grid_search(cfg, dataset=ds, hidden_grid=((8,),),
+                          lr_grid=(0.004,), local_steps=400,
+                          plateau_stop=True, verbose=False)
+    row = res["table"][0]
+    assert row["mean_local_steps"] < 400
+    res_fixed = run_grid_search(cfg, dataset=ds, hidden_grid=((8,),),
+                                lr_grid=(0.004,), local_steps=400,
+                                verbose=False)
+    assert res_fixed["table"][0]["mean_local_steps"] == 400
