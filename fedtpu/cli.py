@@ -141,6 +141,14 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    help="write a jax.profiler trace of the round loop here")
     p.add_argument("--metrics-jsonl", default=None,
                    help="append one JSON line of metrics per round")
+    p.add_argument("--platform", choices=["default", "cpu"],
+                   default="default",
+                   help="force the JAX platform before backend init "
+                        "('cpu' for hermetic debugging / chaos-test "
+                        "subprocesses; 'default' keeps the accelerator). "
+                        "Applied before any compile, like the test "
+                        "suite's CPU pin — a JAX_PLATFORMS env var alone "
+                        "is overridden by this image's sitecustomize")
     p.add_argument("--log-per-client", action="store_true")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--json", action="store_true",
@@ -314,6 +322,13 @@ def main(argv=None) -> int:
                   f"model={preset.model.kind}{list(preset.model.hidden_sizes)} "
                   f"rounds={preset.fed.rounds} weighting={preset.fed.weighting}")
         return 0
+
+    if getattr(args, "platform", "default") == "cpu":
+        # Before ANY backend touch (including the compilation-cache config
+        # below, which imports jax): pin the CPU platform for the whole
+        # process. Mirrors tests/conftest.py's hermetic pin.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     if getattr(args, "compilation_cache", None):
         # Before any compile: every subcommand's first jit lands in (or is

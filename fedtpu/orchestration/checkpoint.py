@@ -68,16 +68,33 @@ def save_checkpoint(directory: str, state, history: dict, step: int) -> str:
     return path
 
 
+def _is_complete(path: str) -> bool:
+    """A round checkpoint is COMMITTED only when both orbax items exist at
+    their final names. Each item is individually atomic (orbax writes to a
+    ``*.orbax-checkpoint-tmp`` dir and renames), but the round is two
+    sequential items — a SIGKILL mid-save leaves ``round_N`` holding only
+    a tmp dir, or ``state`` without ``meta`` (found by the chaos test,
+    tests/test_chaos_resume.py). Such half-rounds must be invisible to
+    resume: ``meta`` is written last, so state-present + meta-present is
+    the commit condition."""
+    return (os.path.isdir(os.path.join(path, "state"))
+            and os.path.isdir(os.path.join(path, "meta")))
+
+
 def latest_step(directory: str) -> Optional[int]:
+    """Largest COMPLETE checkpoint step under ``directory`` (half-written
+    rounds from a crash are skipped — see ``_is_complete``)."""
     if not os.path.isdir(directory):
         return None
     steps = []
     for name in os.listdir(directory):
         if name.startswith("round_"):
             try:
-                steps.append(int(name.split("_")[1]))
+                step = int(name.split("_")[1])
             except (IndexError, ValueError):
                 continue
+            if _is_complete(os.path.join(directory, name)):
+                steps.append(step)
     return max(steps) if steps else None
 
 

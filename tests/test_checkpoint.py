@@ -122,3 +122,21 @@ def test_elastic_resume_carries_global_model(tmp_path):
         lambda a, b: np.testing.assert_allclose(np.asarray(a),
                                                 np.asarray(b), atol=1e-6),
         first.final_params, resumed.final_params)
+
+
+def test_latest_step_skips_half_written_rounds(tmp_path):
+    # A SIGKILL mid-save leaves round_N with only an orbax tmp dir, or
+    # state without meta (meta is written last). Resume must see neither
+    # (tests/test_chaos_resume.py found this live).
+    from fedtpu.orchestration.checkpoint import latest_step
+
+    def fake_round(step, items):
+        d = tmp_path / f"round_{step:06d}"
+        d.mkdir()
+        for name in items:
+            (d / name).mkdir()
+
+    fake_round(2, ["state", "meta"])                 # committed
+    fake_round(4, ["state"])                         # killed before meta
+    fake_round(6, ["state.orbax-checkpoint-tmp"])    # killed mid-state
+    assert latest_step(str(tmp_path)) == 2
