@@ -76,13 +76,24 @@ def bench_mode(name: str, kw: dict, ds, reps: int, rps: int,
                           rounds_per_step=rps, server_opt=server, **kw)
 
     # Fetch-forced timing + flops floor — see fedtpu.utils.timing docstring
-    # for the methodology (round-1 postmortem).
+    # for the methodology (round-1 postmortem). SEVERAL independent samples
+    # per mode (each itself min-of-3 windows): the tunneled transport's
+    # dispatch share jitters by ~±15%, and a single sample let added work
+    # appear cheaper than the baseline (review r2 weak #5) — the caller
+    # compares BANDS, not points.
     from fedtpu.utils.timing import compile_with_flops, timed_rounds
 
     step, flops_per_round = compile_with_flops(step, state, batch)
-    sec, state, m = timed_rounds(step, state, batch, reps, rps,
-                                 peak_flops, flops_per_round, label=name)
-    return {"mode": name, "sec_per_round": float(f"{sec:.4g}"),
+    samples = []
+    for _ in range(5):
+        sec, state, m = timed_rounds(step, state, batch, reps, rps,
+                                     peak_flops, flops_per_round, label=name)
+        samples.append(sec)
+    samples.sort()
+    return {"mode": name,
+            "sec_per_round": float(f"{samples[len(samples) // 2]:.4g}"),
+            "sec_per_round_range": [float(f"{samples[0]:.4g}"),
+                                    float(f"{samples[-1]:.4g}")],
             "rounds_per_step": rps,
             "backend": mesh.devices.ravel()[0].platform}
 
@@ -101,8 +112,18 @@ def main():
     for name, kw in MODES.items():
         row = bench_mode(name, kw, ds, args.reps, args.rounds_per_step, peak)
         if name == "mean":
-            base = row["sec_per_round"]
-        row["vs_mean"] = float(f"{row['sec_per_round'] / base:.3g}")
+            base = row
+        lo, hi = row["sec_per_round_range"]
+        blo, bhi = base["sec_per_round_range"]
+        row["vs_mean"] = float(
+            f"{row['sec_per_round'] / base['sec_per_round']:.3g}")
+        # Ratio band from the two sample bands; a row only claims a real
+        # overhead (or saving) when the bands do NOT overlap. Overlapping
+        # bands => the difference is within dispatch noise, and the row
+        # says so instead of printing a meaningless sub-1.0 ratio.
+        row["vs_mean_range"] = [float(f"{lo / bhi:.3g}"),
+                                float(f"{hi / blo:.3g}")]
+        row["significant"] = bool(lo > bhi or hi < blo)
         print(json.dumps(row), flush=True)
 
 

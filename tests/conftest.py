@@ -28,23 +28,36 @@ assert len(jax.devices()) == 8, (
 import pytest  # noqa: E402
 
 # ---------------------------------------------------------------- quick tier
-# `pytest -m quick` — the CI-fast tier (VERDICT r1 item 7): < 2 min, at
-# least one test from EVERY test module (so a quick run still touches every
-# fedtpu subsystem), selected for speed from the full-suite --durations
-# profile. The full suite (~12 min) remains the merge gate; the quick tier
-# is the inner-loop iteration gate. Names, not patterns, so a typo'd or
-# gone-stale entry fails loudly via the consistency guards at the bottom of
-# pytest_collection_modifyitems below.
+# `pytest -m quick` — the CI-fast tier (VERDICT r1 item 7): < 2 min
+# (round-3 re-tune: measured 110 s on the 1-core verification box; the
+# r2 selection had crept to 2:42 and was re-profiled with --durations and
+# trimmed), at least one test from EVERY in-process test module (so a
+# quick run still touches every fedtpu subsystem; the two subprocess
+# modules are excluded by name below). The full suite (219 tests, ~20
+# min on this box) remains the merge gate; the quick tier is the
+# inner-loop iteration gate. Names,
+# not patterns, so a typo'd or gone-stale entry fails loudly via the
+# consistency guards at the bottom of pytest_collection_modifyitems
+# below.
 QUICK_TESTS = {
-    # aux subsystems (divergence halt, cifar fallback, multihost in-process)
-    "test_aux_subsystems.py::test_nonfinite_guard_halts_diverged_run",
+    # round-3 modules
+    "test_advisor_r3.py::test_peak_flops_negative_slope_warns",
+    "test_dp_accountant.py::test_abadi_et_al_canonical_value",
+    "test_dp_accountant.py::test_full_participation_matches_closed_form",
+    "test_dp_accountant.py::test_monotonicity",
+    "test_dp_accountant.py::test_edge_cases",
+    "test_sweep.py::test_plateau_stop_freezes_exactly_at_the_plateau_point",
+    "test_checkpoint.py::test_latest_step_skips_half_written_rounds",
+    "test_convnet.py::test_convnet_accepts_nhwc_and_flat_inputs",
+    "test_local_steps.py::test_local_steps_equals_rounds_for_single_client",
+    # aux subsystems (cifar fallback, multihost in-process; the divergence
+    # halt is quick-covered by test_pipelined_stop's variant)
     "test_aux_subsystems.py::test_cifar10_synthetic_fallback_shapes",
     "test_aux_subsystems.py::test_synthetic_cifar_deterministic",
     "test_aux_subsystems.py::test_multihost_single_process_paths",
     "test_aux_subsystems.py::test_local_client_slice_multiprocess_simulated",
     "test_aux_subsystems.py::test_looks_multihost_env_detection",
     "test_aux_subsystems.py::test_lazy_top_level_api_resolves",
-    "test_checkpoint.py::test_checkpoint_roundtrip_and_resume",
     "test_chunk_regressions.py::test_no_checkpoint_after_midchunk_early_stop",
     "test_cli.py::test_presets_listing",
     "test_cli.py::test_sweep_bad_table_path_fails_fast",
@@ -55,7 +68,6 @@ QUICK_TESTS = {
     "test_compress.py::test_dequantize_broadcasts_gathered_scales",
     "test_compress.py::test_compress_rejects_delta_path_and_ring",
     "test_compress.py::test_compress_rejects_state_without_shared_start",
-    "test_convnet.py::test_bf16_compute_path",
     "test_data.py::test_synthetic_dataset_shapes",
     "test_data.py::test_income_csv_pipeline_matches_reference_semantics",
     "test_data.py::test_split_bit_parity_with_sklearn",
@@ -70,8 +82,7 @@ QUICK_TESTS = {
     "test_fedavg.py::test_optimizer_state_is_not_averaged",
     "test_graft_entry.py::"
     "test_dryrun_after_backend_init_without_flag_raises_cleanly",
-    "test_local_steps.py::test_prox_zero_is_plain_fedavg",
-    "test_loop.py::test_early_stopping_with_huge_tolerance",
+    "test_loop.py::test_run_experiment_history_shapes",
     "test_metrics.py::test_metrics_match_sklearn[2-0]",
     "test_metrics.py::test_metrics_match_sklearn[5-2]",
     "test_metrics.py::test_zero_division_semantics",
@@ -87,12 +98,10 @@ QUICK_TESTS = {
     "test_pallas.py::test_fused_mlp_matches_xla_apply",
     "test_pallas.py::test_weighted_average_kernel_matches_numpy",
     "test_parity.py::test_limitation_demonstrated",
-    "test_participation.py::test_full_participation_is_default_behavior",
     "test_participation.py::test_sampling_is_deterministic_in_seed",
     "test_participation.py::test_sampled_average_over_participants_only",
     "test_personalize.py::test_personalize_rejects_zero_steps",
     "test_pipelined_stop.py::test_pipelined_divergence_still_halts",
-    "test_personalize.py::test_personalization_off_by_default",
     "test_review_fixes.py::test_numeric_labels_reencoded_to_contiguous_indices",
     "test_review_fixes.py::test_empty_shards_excluded_from_client_mean",
     "test_ring.py::test_ring_matches_global_sum[shape0-ring_all_reduce_sum]",
@@ -112,8 +121,6 @@ QUICK_TESTS = {
     "test_server_opt.py::test_missing_server_state_is_a_clear_error",
     "test_server_opt.py::test_stale_server_state_is_a_clear_error",
     "test_server_opt.py::test_dp_noise_requires_clip",
-    "test_sweep.py::test_best_config_is_tracked",
-    "test_sweep.py::test_weights_dropped_without_flag",
     "test_timing.py::test_force_fetch_returns_scalar_from_tree",
     "test_timing.py::test_force_fetch_depends_on_computation",
     "test_timing.py::test_force_fetch_refuses_host_only_trees",
@@ -121,10 +128,11 @@ QUICK_TESTS = {
     "test_timing.py::test_measured_peak_flops_is_positive_and_sane",
     "test_timing.py::test_timer_laps",
     "test_tp.py::test_mesh_2d_shape",
-    "test_tp.py::test_hidden_weights_actually_sharded_over_model",
     "test_tp.py::test_unsupported_combos_raise",
     # test_multihost_e2e spawns 2 OS processes (~28 s) and stays full-tier
     # only; fedtpu/parallel/multihost.py is covered above in-process.
+    # test_chaos_resume SIGKILLs subprocess CLI runs (~60 s) and stays
+    # full-tier only; the resume machinery is covered by test_checkpoint.
 }
 
 
@@ -157,7 +165,8 @@ def pytest_collection_modifyitems(config, items):
             raise pytest.UsageError(
                 f"conftest QUICK_TESTS entries match nothing (renamed or "
                 f"removed tests?): {sorted(stale)}")
-    uncovered = (modules_all - modules_quick - {"test_multihost_e2e.py"}
+    uncovered = (modules_all - modules_quick
+                 - {"test_multihost_e2e.py", "test_chaos_resume.py"}
                  if quick_modules_expected <= modules_all else set())
     if uncovered:
         raise pytest.UsageError(
