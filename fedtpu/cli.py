@@ -246,7 +246,10 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
                             fed=fed, run=run)
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
+    """The complete argument parser, exposed separately from ``main`` so
+    tests can introspect the real flag surface (e.g. the docs-accuracy
+    guard that every ``--flag`` the documentation mentions exists)."""
     parser = argparse.ArgumentParser(prog="fedtpu", description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -313,8 +316,11 @@ def main(argv=None) -> int:
     _add_common_overrides(parity_p)
 
     sub.add_parser("presets", help="list shipped presets")
+    return parser
 
-    args = parser.parse_args(argv)
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
 
     if args.cmd == "presets":
         for name, preset in sorted(PRESETS.items()):
@@ -391,8 +397,8 @@ def main(argv=None) -> int:
     elif args.cmd == "parity":
         from fedtpu.parity.sklearn_warmstart import run_parity_demo
         summary = run_parity_demo(cfg, verbose=not args.quiet)
-    else:  # pragma: no cover
-        parser.error(f"unknown command {args.cmd}")
+    else:  # pragma: no cover — subparsers(required=True) rejects earlier
+        raise SystemExit(f"unknown command {args.cmd}")
 
     if args.json:
         print(json.dumps(summary, default=float))

@@ -147,6 +147,46 @@ def main():
         f.write(repr(acc2))
     print(f"worker {pid}: tp-over-DCN round ok acc={acc2:.4f}", flush=True)
 
+    # --- int8-quantized exchange across the process boundary: the
+    # all_gather of int8 payloads + per-client scales crosses TCP (the
+    # wire-size win this mode exists for — D/8 of the f32 psum traffic).
+    # One round must stay within quantization error of exact averaging.
+    q_state = init_federated_state(jax.random.key(SEED), mesh, NUM_CLIENTS,
+                                   init_fn, tx, same_init=True,
+                                   shared_start=True)
+    q_step = build_round_fn(mesh, apply_fn, tx, CLASSES, compress="int8")
+    q_state, qm = q_step(q_state, batch)
+    q_g = fetch_global(q_state["params"], mesh)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=5e-4),
+                 q_g, psum_g)
+    assert np.isfinite(float(np.asarray(qm["client_mean"]["accuracy"])))
+    print(f"worker {pid}: int8 exchange across processes ok", flush=True)
+
+    # --- Byzantine-robust median with the attack crossing the boundary:
+    # clients 0-1 (process 0's devices) submit 10x sign-flipped updates;
+    # the order statistics run on all_gather'd values spanning both
+    # processes. The median must hold the global near the honest step
+    # while the plain mean is dragged past it.
+    def one_round_move(**round_kw):
+        s = init_federated_state(jax.random.key(SEED), mesh, NUM_CLIENTS,
+                                 init_fn, tx, same_init=True)
+        start = jax.tree.leaves(fetch_global(s["params"], mesh))[0][0]
+        r_step = build_round_fn(mesh, apply_fn, tx, CLASSES,
+                                weighting="uniform", **round_kw)
+        s, _ = r_step(s, batch)
+        end = jax.tree.leaves(fetch_global(s["params"], mesh))[0][0]
+        return float(np.abs(end - start).max())
+
+    honest = one_round_move()
+    attacked_mean = one_round_move(byzantine_clients=2)
+    defended = one_round_move(byzantine_clients=2,
+                              robust_aggregation="median")
+    assert attacked_mean > 1.5 * honest, (honest, attacked_mean)
+    assert defended <= 1.5 * honest, (honest, defended)
+    print(f"worker {pid}: median holds under cross-process Byzantine "
+          f"injection ok (honest {honest:.2e}, mean {attacked_mean:.2e}, "
+          f"median {defended:.2e})", flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -76,10 +76,15 @@ def test_two_process_round_matches_single_process(tmp_path):
     assert np.isfinite(accs[0])
 
     # The worker also ran (a) explicit ring/ppermute aggregation with its
-    # hops crossing the process boundary (asserted == psum in-worker) and
+    # hops crossing the process boundary (asserted == psum in-worker),
     # (b) a 2-D round on a transposed mesh whose MODEL-axis pairs span
     # both processes — true tp-over-DCN (asserted == the 1-D round
-    # in-worker). Cross-process agreement of the tp metrics:
+    # in-worker), (c) one int8-quantized exchange round whose gathered
+    # payloads cross TCP (asserted within quantization error of exact),
+    # and (d) a Byzantine-median round where the poisoned clients live on
+    # process 0 and the order statistics span both processes (asserted to
+    # hold the global where the mean breaks). Cross-process agreement of
+    # the tp metrics:
     tp_accs = [float(open(tmp_path / f"tp_acc_{pid}.txt").read())
                for pid in (0, 1)]
     assert tp_accs[0] == tp_accs[1]
