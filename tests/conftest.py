@@ -33,7 +33,7 @@ import pytest  # noqa: E402
 # r2 selection had crept to 2:42 and was re-profiled with --durations and
 # trimmed), at least one test from EVERY in-process test module (so a
 # quick run still touches every fedtpu subsystem; the two subprocess
-# modules are excluded by name below). The full suite (219 tests, ~20
+# modules are excluded by name below). The full suite (~255 tests, ~22
 # min on this box) remains the merge gate; the quick tier is the
 # inner-loop iteration gate. Names,
 # not patterns, so a typo'd or gone-stale entry fails loudly via the
@@ -48,6 +48,10 @@ QUICK_TESTS = {
     "test_dp_accountant.py::test_edge_cases",
     "test_sweep.py::test_plateau_stop_freezes_exactly_at_the_plateau_point",
     "test_checkpoint.py::test_latest_step_skips_half_written_rounds",
+    "test_combo_matrix.py::"
+    "test_combo_round_executes_or_raises_cleanly[plain-none]",
+    "test_combo_matrix.py::"
+    "test_combo_round_executes_or_raises_cleanly[median-sample]",
     "test_convnet.py::test_convnet_accepts_nhwc_and_flat_inputs",
     "test_local_steps.py::test_local_steps_equals_rounds_for_single_client",
     # aux subsystems (cifar fallback, multihost in-process; the divergence
@@ -129,7 +133,8 @@ QUICK_TESTS = {
     "test_timing.py::test_timer_laps",
     "test_tp.py::test_mesh_2d_shape",
     "test_tp.py::test_unsupported_combos_raise",
-    # test_multihost_e2e spawns 2 OS processes (~28 s) and stays full-tier
+    # test_multihost_e2e spawns 2 OS processes (~70 s for the round-kernel
+    # worker since the int8/Byzantine sections joined) and stays full-tier
     # only; fedtpu/parallel/multihost.py is covered above in-process.
     # test_chaos_resume SIGKILLs subprocess CLI runs (~60 s) and stays
     # full-tier only; the resume machinery is covered by test_checkpoint.

@@ -1,0 +1,120 @@
+"""Feature-combination matrix: one tiny round for every VALID pairing of
+the aggregation-path knobs, asserting the round executes, stays finite,
+and keeps every client slot synchronized on the new global.
+
+The individual features are each pinned by their own module; what this
+module guards is the CROSS-feature surface (e.g. local_steps x compress,
+participation x server_opt, robust x rounds_per_step) where an
+interaction bug would hide from per-feature tests. Invalid combinations
+are asserted to raise — the documented constraint matrix of
+fedtpu/parallel/round.py, exercised as a matrix rather than ad hoc.
+"""
+
+import itertools
+
+import jax
+import numpy as np
+import pytest
+
+from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.ops.server_opt import make_server_optimizer
+from fedtpu.parallel import client_sharding, make_mesh
+from fedtpu.parallel.round import build_round_fn, init_federated_state
+
+NUM_CLIENTS = 8
+
+
+def _fixtures():
+    x, y = synthetic_income_like(64, 4, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=NUM_CLIENTS,
+                                            shuffle=False))
+    mesh = make_mesh(num_clients=NUM_CLIENTS)
+    shard = client_sharding(mesh)
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=4,
+                                                hidden_sizes=(4,)))
+    tx = build_optimizer(OptimConfig())
+    return mesh, batch, init_fn, apply_fn, tx
+
+
+# One axis per aggregation-path knob; entries are build_round_fn kwargs.
+BASES = {
+    "plain": {},
+    "ring": dict(aggregation="ring"),
+    "fedadam": dict(server_opt="fedadam"),
+    "dp": dict(dp_clip_norm=1.0, dp_noise_multiplier=0.1,
+               weighting="uniform"),
+    "int8": dict(compress="int8"),
+    "median": dict(robust_aggregation="median", weighting="uniform"),
+}
+MODIFIERS = {
+    "none": {},
+    "local5": dict(local_steps=5),
+    "prox": dict(local_steps=3, prox_mu=0.1),
+    "sample": dict(participation_rate=0.5),
+    "scan3": dict(rounds_per_step=3),
+    "byz": dict(byzantine_clients=2, weighting="uniform"),
+}
+
+# Combinations build_round_fn documents as unsupported (it raises);
+# everything else must run. Kept as data so a constraint change shows up
+# as a diff here. Notable VALID pairings the matrix proves: DP+sampling
+# (fixed q*C denominator), server-opt+sampling, int8+Byzantine,
+# DP+Byzantine (clip bounds the poison), robust+Byzantine (the
+# attack/defense pairing).
+EXPECT_RAISE = {
+    ("median", "sample"),      # robust needs full participation
+}
+
+
+def _merged(base: str, mod: str):
+    # Every axis entry that sets `weighting` sets "uniform", so the plain
+    # merge is already consistent.
+    return {**BASES[base], **MODIFIERS[mod]}
+
+
+@pytest.mark.parametrize("base,mod",
+                         list(itertools.product(BASES, MODIFIERS)))
+def test_combo_round_executes_or_raises_cleanly(base, mod):
+    kw = _merged(base, mod)
+
+    server = None
+    if "server_opt" in kw:
+        server = make_server_optimizer(kw.pop("server_opt"),
+                                       learning_rate=0.02)
+
+    if (base, mod) in EXPECT_RAISE:
+        mesh, _, init_fn, apply_fn, tx = _fixtures()
+        with pytest.raises(ValueError):
+            build_round_fn(mesh, apply_fn, tx, 2, server_opt=server, **kw)
+        return
+
+    mesh, batch, init_fn, apply_fn, tx = _fixtures()
+    needs_server_state = server is not None or kw.get("dp_clip_norm", 0) > 0
+    state_server = server
+    if state_server is None and needs_server_state:
+        from fedtpu.ops.server_opt import identity_server_optimizer
+        state_server = identity_server_optimizer()
+    state = init_federated_state(
+        jax.random.key(0), mesh, NUM_CLIENTS, init_fn, tx, same_init=True,
+        server_opt=state_server,
+        shared_start=kw.get("compress", "none") != "none")
+
+    step = build_round_fn(mesh, apply_fn, tx, 2, server_opt=server, **kw)
+    state, metrics = step(state, batch)
+    acc = np.asarray(metrics["client_mean"]["accuracy"])
+    assert np.all(np.isfinite(acc))
+    # rounds_per_step stacks a leading axis.
+    assert acc.shape == ((3,) if kw.get("rounds_per_step") == 3 else ())
+    # Every client slot must carry the identical new global.
+    for leaf in jax.tree.leaves(state["params"]):
+        a = np.asarray(leaf)
+        np.testing.assert_allclose(a, np.broadcast_to(a[:1], a.shape),
+                                   atol=1e-6)
+    # The round counter advanced by the number of rounds executed.
+    assert int(np.asarray(state["round"])) == kw.get("rounds_per_step", 1)
