@@ -83,6 +83,41 @@ def rdp_sampled_gaussian(q: float, noise_multiplier: float,
     return _logsumexp(terms) / (order - 1)
 
 
+def rdp_vector(q: float, noise_multiplier: float,
+               orders: Sequence[int] = DEFAULT_ORDERS) -> list:
+    """Per-STEP RDP of the SGM at every order in the grid — the additive
+    currency of composition. Heterogeneous segments (a resumed run whose
+    noise multiplier or sampling rate changed) compose by summing their
+    per-segment ``steps * rdp_vector`` element-wise; ``epsilon_from_rdp``
+    converts the total."""
+    if noise_multiplier <= 0.0:
+        return [math.inf] * len(orders)
+    return [rdp_sampled_gaussian(q, noise_multiplier, a) for a in orders]
+
+
+def epsilon_from_rdp(rdp: Sequence[float], delta: float,
+                     orders: Sequence[int] = DEFAULT_ORDERS) -> dict:
+    """(epsilon, delta) from an ACCUMULATED RDP curve (one value per order
+    in ``orders``): epsilon = min_a rdp[a] + log(1/delta)/(a-1). An
+    all-zero curve is zero spend (epsilon 0) — the conversion penalty
+    log(1/delta)/(a-1) applies to compositions, not to no mechanism at
+    all (mirrors ``privacy_spent(steps=0)``)."""
+    if delta <= 0.0 or delta >= 1.0:
+        raise ValueError(f"delta={delta} outside (0, 1)")
+    if len(rdp) != len(orders):
+        raise ValueError(f"rdp curve has {len(rdp)} entries for "
+                         f"{len(orders)} orders")
+    if all(r == 0 for r in rdp):
+        return {"epsilon": 0.0, "delta": delta, "order": None}
+    best_eps, best_order = math.inf, None
+    log_inv_delta = math.log(1.0 / delta)
+    for a, r in zip(orders, rdp):
+        eps = r + log_inv_delta / (a - 1)
+        if eps < best_eps:
+            best_eps, best_order = eps, a
+    return {"epsilon": best_eps, "delta": delta, "order": best_order}
+
+
 def privacy_spent(q: float, noise_multiplier: float, steps: int,
                   delta: float,
                   orders: Sequence[int] = DEFAULT_ORDERS) -> dict:
@@ -99,14 +134,9 @@ def privacy_spent(q: float, noise_multiplier: float, steps: int,
         return {"epsilon": 0.0, "delta": delta, "order": None}
     if noise_multiplier <= 0.0:
         return {"epsilon": math.inf, "delta": delta, "order": None}
-    best_eps, best_order = math.inf, None
-    log_inv_delta = math.log(1.0 / delta)
-    for a in orders:
-        rdp = rdp_sampled_gaussian(q, noise_multiplier, a) * steps
-        eps = rdp + log_inv_delta / (a - 1)
-        if eps < best_eps:
-            best_eps, best_order = eps, a
-    return {"epsilon": best_eps, "delta": delta, "order": best_order}
+    return epsilon_from_rdp(
+        [r * steps for r in rdp_vector(q, noise_multiplier, orders)],
+        delta, orders)
 
 
 def closed_form_gaussian_epsilon(noise_multiplier: float, steps: int,
@@ -120,5 +150,6 @@ def closed_form_gaussian_epsilon(noise_multiplier: float, steps: int,
     return t / (2 * s * s) + math.sqrt(2 * t * math.log(1 / delta)) / s
 
 
-__all__ = ["DEFAULT_ORDERS", "rdp_sampled_gaussian", "privacy_spent",
+__all__ = ["DEFAULT_ORDERS", "rdp_sampled_gaussian", "rdp_vector",
+           "epsilon_from_rdp", "privacy_spent",
            "closed_form_gaussian_epsilon"]

@@ -41,11 +41,16 @@ def _strip_marker(state):
     return state
 
 
-def save_checkpoint(directory: str, state, history: dict, step: int) -> str:
-    """Write state + {history, step, num_clients} under
+def save_checkpoint(directory: str, state, history: dict, step: int,
+                    extra_meta: Optional[dict] = None) -> str:
+    """Write state + {history, step, num_clients, **extra_meta} under
     ``directory/round_<step>``. ``num_clients`` lives in the tiny meta item
     so elastic-resume detection (fedtpu.orchestration.loop) never has to
     read the full state twice on the common same-count path.
+    ``extra_meta``: additional small arrays/scalars for the meta item —
+    the loop uses it to persist the cumulative DP RDP curve so a resumed
+    run composes its privacy spend instead of re-deriving it from the
+    possibly-changed current config.
 
     Multi-process (jax.distributed): EVERY process must call this — orbax
     save is a collective (it barriers internally; a process-0-only call
@@ -60,11 +65,12 @@ def save_checkpoint(directory: str, state, history: dict, step: int) -> str:
         state_item = to_numpy(state_item)
     ckptr.save(os.path.join(path, "state"), state_item, force=True)
     num_clients = jax.tree.leaves(state["params"])[0].shape[0]
-    ckptr.save(os.path.join(path, "meta"),
-               {"history": {k: np.asarray(v) for k, v in history.items()},
-                "step": np.asarray(step),
-                "num_clients": np.asarray(num_clients)},
-               force=True)
+    meta = {"history": {k: np.asarray(v) for k, v in history.items()},
+            "step": np.asarray(step),
+            "num_clients": np.asarray(num_clients)}
+    if extra_meta:
+        meta.update({k: np.asarray(v) for k, v in extra_meta.items()})
+    ckptr.save(os.path.join(path, "meta"), meta, force=True)
     return path
 
 
@@ -117,6 +123,18 @@ def load_checkpoint_raw(directory: str, step: Optional[int] = None
     return state, history, int(np.asarray(meta["step"]))
 
 
+def load_meta(directory: str, step: Optional[int] = None) -> dict:
+    """The raw meta item of a checkpoint (history, step, num_clients, and
+    any ``extra_meta`` the save attached — e.g. the cumulative DP RDP
+    curve)."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    return ocp.PyTreeCheckpointer().restore(
+        os.path.join(_ckpt_path(directory, step), "meta"))
+
+
 def saved_num_clients(raw_state: dict) -> int:
     """Client count of a raw checkpoint: the leading axis every params leaf
     carries."""
@@ -128,13 +146,7 @@ def peek_num_clients(directory: str, step: Optional[int] = None
     """Client count of a checkpoint from the meta item alone (no state
     read). None for checkpoints written before num_clients was recorded —
     callers then fall back to :func:`load_checkpoint_raw`."""
-    if step is None:
-        step = latest_step(directory)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
-    meta = ocp.PyTreeCheckpointer().restore(
-        os.path.join(_ckpt_path(directory, step), "meta"))
-    nc = meta.get("num_clients")
+    nc = load_meta(directory, step).get("num_clients")
     return None if nc is None else int(np.asarray(nc))
 
 

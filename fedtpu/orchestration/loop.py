@@ -85,6 +85,22 @@ class ExperimentResult:
     # pipelined early stop this exceeds rounds_run by the dropped
     # in-flight overshoot chunk. 0 means "same as rounds_run".
     rounds_trained: int = 0
+    # Cumulative per-order RDP curve of the released state (None when DP
+    # noise is off). Composes across resumes: rounds noised under an
+    # earlier config are charged at THAT config's rate (restored from the
+    # checkpoint meta), not the current one.
+    dp_rdp_total: Optional[np.ndarray] = None
+    # True when a resumed pre-r3 checkpoint carried no RDP curve and the
+    # pre-resume rounds had to be charged at the current config's rate.
+    dp_base_assumed: bool = False
+    # True when rounds AFTER the noised ones re-trained on the private
+    # data with noise off — the released model then has NO (eps, delta)
+    # guarantee, whatever the curve says (reported as epsilon=inf).
+    dp_guarantee_void: bool = False
+    # True when the epsilon composes noised rounds from EARLIER resumed
+    # segments: the reported (noise_multiplier, sampling_rate) describe
+    # only the current segment and cannot re-derive the epsilon alone.
+    dp_composed: bool = False
 
     def summary(self) -> dict:
         last = {k: v[-1] for k, v in self.global_metrics.items() if v}
@@ -119,18 +135,49 @@ class ExperimentResult:
         extra noised rounds, and a privacy accountant must never
         under-count. See fedtpu.ops.dp_accountant for the RDP analysis."""
         fed = self.config.fed
-        if fed.dp_noise_multiplier <= 0:
+        curve_spent = (self.dp_rdp_total is not None
+                       and bool(np.any(np.asarray(self.dp_rdp_total) > 0)))
+        if fed.dp_noise_multiplier <= 0 and not curve_spent:
             return {}
-        from fedtpu.ops.dp_accountant import privacy_spent
+        from fedtpu.ops.dp_accountant import (epsilon_from_rdp,
+                                              privacy_spent)
         steps = max(self.rounds_run, self.rounds_trained)
-        spent = privacy_spent(q=fed.participation_rate,
-                              noise_multiplier=fed.dp_noise_multiplier,
-                              steps=steps, delta=fed.dp_delta)
-        return {"epsilon": spent["epsilon"], "delta": spent["delta"],
-                "rdp_order": spent["order"],
-                "noise_multiplier": fed.dp_noise_multiplier,
-                "sampling_rate": fed.participation_rate,
-                "rounds": steps}
+        if self.dp_rdp_total is not None:
+            # The composed curve — exact across resumes with changed
+            # (noise multiplier, sampling rate), and still reported when
+            # the CURRENT segment ran with noise off but earlier noised
+            # segments built the released model.
+            spent = epsilon_from_rdp(list(self.dp_rdp_total), fed.dp_delta)
+        else:
+            spent = privacy_spent(q=fed.participation_rate,
+                                  noise_multiplier=fed.dp_noise_multiplier,
+                                  steps=steps, delta=fed.dp_delta)
+        out = {"epsilon": spent["epsilon"], "delta": spent["delta"],
+               "rdp_order": spent["order"],
+               "noise_multiplier": fed.dp_noise_multiplier,
+               "sampling_rate": fed.participation_rate,
+               "rounds": steps}
+        if self.dp_composed:
+            # (sigma, q) above are the CURRENT segment's only; the
+            # epsilon composes earlier resumed segments' spend from the
+            # persisted RDP curve and cannot be re-derived from this
+            # dict's triple alone.
+            out["composed_over_resumed_segments"] = True
+        if self.dp_guarantee_void:
+            # Unnoised rounds re-trained on the private data after the
+            # noised ones — NOT post-processing: no finite (eps, delta)
+            # holds for the released model, whatever was spent before.
+            import math
+            out["epsilon"] = math.inf
+            out["rdp_order"] = None
+            out["guarantee_void"] = ("rounds trained with noise off "
+                                     "after noised rounds")
+        if self.dp_base_assumed:
+            # Pre-r3 checkpoint: the pre-resume rounds' true (sigma, q)
+            # are unrecorded — they were charged at the CURRENT config's
+            # rate, so epsilon may be off for those rounds.
+            out["resume_rdp"] = "assumed_current_config"
+        return out
 
 
 @dataclasses.dataclass
@@ -375,14 +422,18 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
 
     start_round = 0
     restored_history = None
+    restored_meta = None
     if resume and cfg.run.checkpoint_dir:
         from fedtpu.orchestration.checkpoint import (
-            latest_step, load_checkpoint, load_checkpoint_raw,
-            peek_num_clients, saved_num_clients)
+            latest_step, load_checkpoint, load_checkpoint_raw, load_meta,
+            saved_num_clients)
         if latest_step(cfg.run.checkpoint_dir) is not None:
-            # Cheap elastic detection from the meta item; only a count
-            # MISMATCH (or a pre-num_clients checkpoint) pays the raw read.
-            saved_c = peek_num_clients(cfg.run.checkpoint_dir)
+            # ONE meta read serves elastic detection AND the DP RDP-curve
+            # restore below; only a count MISMATCH (or a pre-num_clients
+            # checkpoint) pays the raw state read.
+            restored_meta = load_meta(cfg.run.checkpoint_dir)
+            nc = restored_meta.get("num_clients")
+            saved_c = None if nc is None else int(np.asarray(nc))
             if saved_c is None:
                 raw, raw_history, raw_round = load_checkpoint_raw(
                     cfg.run.checkpoint_dir)
@@ -427,6 +478,80 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                           "carried over, fresh client optimizer state).",
                           flush=True)
 
+    # DP RDP bookkeeping: the cumulative per-order RDP curve is the
+    # resumable currency of the privacy spend (RDP composes additively,
+    # so a resume that CHANGES noise multiplier or sampling rate still
+    # accounts every round at the rate it was actually noised with —
+    # review r3: charging all rounds at the current config's rate would
+    # under-report epsilon, the unsafe direction). Maintained and
+    # persisted in every checkpoint's meta item UNCONDITIONALLY (a zero
+    # curve while DP is off), so a DP-off resume segment carries the
+    # earlier segments' spend forward instead of silently destroying it.
+    from fedtpu.ops.dp_accountant import DEFAULT_ORDERS, rdp_vector
+    dp_per_step = (np.asarray(rdp_vector(cfg.fed.participation_rate,
+                                         cfg.fed.dp_noise_multiplier))
+                   if cfg.fed.dp_noise_multiplier > 0
+                   else np.zeros(len(DEFAULT_ORDERS)))
+    dp_rdp_base = np.zeros(len(DEFAULT_ORDERS))
+    dp_base_assumed = False
+    dp_void_base = False
+    if start_round > 0:
+        meta_d = restored_meta or {}
+        # Both honesty flags persist WITH the curve and OR forward — once
+        # a segment's accounting was assumed (pre-r3 checkpoint) or its
+        # guarantee voided (unnoised rounds below), no later resume may
+        # silently launder the epsilon back to "clean".
+        dp_base_assumed = bool(np.asarray(
+            meta_d.get("dp_rdp_assumed", False)))
+        dp_void_base = bool(np.asarray(
+            meta_d.get("dp_guarantee_void", False)))
+        saved_rdp = meta_d.get("dp_rdp")
+        saved_orders = meta_d.get("dp_rdp_orders")
+        if saved_rdp is not None:
+            saved_rdp = np.asarray(saved_rdp, dtype=np.float64)
+            if saved_orders is None and len(saved_rdp) == len(dp_per_step):
+                # Same-era checkpoint without the orders array: the grid
+                # length matching today's is the best available identity
+                # evidence.
+                dp_rdp_base = saved_rdp
+            elif saved_orders is not None:
+                # Re-project the saved curve onto today's order grid by
+                # ORDER VALUE, so a grid change between versions never
+                # discards the spend. Orders the old curve lacks get +inf
+                # — they drop out of the epsilon minimization, which can
+                # only LOOSEN epsilon (the safe direction).
+                by_order = dict(zip((int(o) for o in
+                                     np.asarray(saved_orders)), saved_rdp))
+                dp_rdp_base = np.asarray(
+                    [by_order.get(int(o), np.inf) for o in DEFAULT_ORDERS])
+            else:
+                # Unidentifiable grid: the spend exists but cannot be
+                # attributed per order — assume the current rate, flagged.
+                dp_rdp_base = dp_per_step * start_round
+                dp_base_assumed = True
+        elif cfg.fed.dp_noise_multiplier > 0:
+            # Pre-r3 checkpoint without the curve under a DP config: the
+            # only available assumption is the current config's rate —
+            # flagged in the report so the epsilon is never silently
+            # wrong. (Without DP on, a missing curve stays zero: the
+            # pre-r3 non-DP behavior, not a claim.)
+            dp_rdp_base = dp_per_step * start_round
+            dp_base_assumed = True
+
+    def dp_rdp_at(round_label: int):
+        """Cumulative RDP curve when the state is at ``round_label``."""
+        return dp_rdp_base + dp_per_step * max(0, round_label - start_round)
+
+    def dp_void_at(round_label: int) -> bool:
+        """True when the released model has NO (epsilon, delta) guarantee
+        despite a nonzero spend: some rounds after the noised ones
+        re-trained on the private data with the noise OFF (that is not
+        post-processing — it voids the guarantee; review r3)."""
+        trained_unnoised = (cfg.fed.dp_noise_multiplier <= 0
+                            and round_label > start_round)
+        return bool(dp_void_base
+                    or (trained_unnoised and np.any(dp_rdp_base > 0)))
+
     history = {k: [] for k in METRIC_NAMES}
     pooled_hist = {k: [] for k in METRIC_NAMES}
     per_client_hist = {k: [] for k in METRIC_NAMES}
@@ -468,7 +593,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # barriers internally (see save_checkpoint).
             save_checkpoint(
                 os.path.join(cfg.run.checkpoint_dir, "diverged"),
-                state, history, label_round)
+                state, history, label_round,
+                extra_meta={"dp_rdp": dp_rdp_at(label_round),
+                            "dp_rdp_orders": np.asarray(DEFAULT_ORDERS),
+                            "dp_rdp_assumed": dp_base_assumed,
+                            "dp_guarantee_void": dp_void_at(label_round)})
         stopped_early = True
         diverged = True
 
@@ -700,7 +829,13 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # collective (barriers internally — a process-0-only call
                 # deadlocks), and it writes each client shard from the
                 # process that owns it (true distributed checkpointing).
-                save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd)
+                save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd,
+                                extra_meta={
+                                    "dp_rdp": dp_rdp_at(rnd),
+                                    "dp_rdp_orders":
+                                        np.asarray(DEFAULT_ORDERS),
+                                    "dp_rdp_assumed": dp_base_assumed,
+                                    "dp_guarantee_void": dp_void_at(rnd)})
 
         if pending is not None and not stopped_early:
             process_chunk(*pending, state_round=rnd)
@@ -773,13 +908,24 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         # released params trained through (> rounds_run after a pipelined
         # early stop's overshoot chunk; the DP accountant must count it).
         rounds_trained=int(np.asarray(jax.device_get(_rep(state["round"])))),
+        dp_base_assumed=dp_base_assumed,
     )
+    result = dataclasses.replace(
+        result, dp_rdp_total=dp_rdp_at(result.rounds_trained),
+        dp_guarantee_void=dp_void_at(result.rounds_trained),
+        dp_composed=bool(np.any(dp_rdp_base > 0)))
     if verbose:
         dp = result.privacy_spent()
         if dp:
+            notes = ""
+            if dp.get("composed_over_resumed_segments"):
+                notes += ("; composed over resumed segments — sigma/q "
+                          "shown are the current segment's")
+            if dp.get("guarantee_void"):
+                notes += f"; GUARANTEE VOID: {dp['guarantee_void']}"
             print(f"DP budget spent: epsilon={dp['epsilon']:.3f} at "
                   f"delta={dp['delta']:.1e} (noise multiplier "
                   f"{dp['noise_multiplier']}, sampling rate "
                   f"{dp['sampling_rate']}, {dp['rounds']} rounds; RDP "
-                  f"order {dp['rdp_order']})", flush=True)
+                  f"order {dp['rdp_order']}{notes})", flush=True)
     return result

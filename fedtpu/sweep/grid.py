@@ -51,7 +51,7 @@ LR_GRID = (0.002, 0.005, 0.004, 0.008, 0.01, 0.02, 0.05, 0.1, 0.2)
 
 def _build_sweep_fn(mesh, num_classes: int, local_steps: int, optim_cfg,
                     plateau_stop: bool = False, tol: float = 1e-4,
-                    n_iter_no_change: int = 10):
+                    n_iter_no_change: int = 10, l2_alpha: float = 0.0):
     """One compiled program: train every (lr, client) pair for up to
     ``local_steps`` full-batch steps, then uniform-average over clients
     per lr.
@@ -73,13 +73,30 @@ def _build_sweep_fn(mesh, num_classes: int, local_steps: int, optim_cfg,
     default: the fixed-step trainer is the documented fedtpu semantics;
     the flag exists to measure the reference-faithful winner
     (hyperparameters_tuning.py:90).
+
+    ``l2_alpha``: sklearn's L2 penalty ``0.5*alpha*||coefs||^2/n_samples``
+    — the term MLPClassifier adds to both the loss its plateau detector
+    watches (``loss_curve_``) AND the gradient its updates follow
+    (intercepts are NOT penalized, matching sklearn). 0 = fedtpu's plain
+    CE; ``run_grid_search(plateau_stop=True)`` passes sklearn's default
+    1e-4 so the plateau semantics are faithful end to end (review r3:
+    with tol=1e-4 the penalty term is the same scale as the improvement
+    bar, so omitting it shifts stop points).
     """
     base = optax.scale_by_adam(b1=optim_cfg.b1, b2=optim_cfg.b2,
                                eps=optim_cfg.eps, eps_root=0.0)
 
     def train_one(params, opt_state, lr, x, y, mask):
         def loss_fn(q):
-            return masked_cross_entropy(mlp_apply(q, x), y, mask)
+            loss = masked_cross_entropy(mlp_apply(q, x), y, mask)
+            if l2_alpha > 0.0:
+                # sklearn penalizes coefs_ only, averaged over the local
+                # fit's sample count (_multilayer_perceptron._backprop).
+                sq = sum(jnp.sum(jnp.square(lyr["w"]))
+                         for lyr in q["layers"])
+                loss = loss + 0.5 * l2_alpha * sq / jnp.maximum(
+                    mask.sum().astype(jnp.float32), 1.0)
+            return loss
 
         def step(carry, _):
             p, s, best, no_imp, active, steps = carry
@@ -170,7 +187,8 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
 
     ``plateau_stop=True`` selects sklearn's early-stopping semantics for
     the local fits (``max_iter`` as a cap with tol-1e-4 / 10-epoch plateau
-    detection — what ``MLPClassifier(max_iter=400)`` at
+    detection, AND sklearn's default L2 penalty alpha=1e-4 in the watched
+    loss and the updates — what ``MLPClassifier(max_iter=400)`` at
     hyperparameters_tuning.py:90 actually does) instead of the fixed
     ``local_steps`` count; each table row then carries the mean steps the
     clients actually ran (``mean_local_steps``)."""
@@ -195,7 +213,8 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         # One compiled program per architecture (shapes differ across
         # ``hidden``); in the sequential path all 9 lr runs share it.
         sweep_fn = _build_sweep_fn(mesh, ds.num_classes, local_steps,
-                                   cfg.optim, plateau_stop=plateau_stop)
+                                   cfg.optim, plateau_stop=plateau_stop,
+                                   l2_alpha=1e-4 if plateau_stop else 0.0)
         for lr_group in lr_groups:
             l = len(lr_group)
             # Same-seed init per config == fresh random_state=42 model per

@@ -95,7 +95,110 @@ def test_run_summary_reports_epsilon():
             > privacy_spent(1.0, 1.0, res_o.rounds_run, 1e-5)["epsilon"])
 
     # Clip-only runs (no noise) must NOT claim an epsilon.
+    # (composition across resume: test_resume_composes_heterogeneous_rdp)
     clip_only = dataclasses.replace(
         cfg, fed=dataclasses.replace(cfg.fed, dp_noise_multiplier=0.0))
     res2 = run_experiment(clip_only, verbose=False)
     assert "dp" not in res2.summary()
+
+
+def test_rdp_vector_roundtrip_matches_privacy_spent():
+    from fedtpu.ops.dp_accountant import epsilon_from_rdp, rdp_vector
+
+    v = rdp_vector(0.3, 1.5)
+    direct = privacy_spent(0.3, 1.5, 40, 1e-5)
+    via_curve = epsilon_from_rdp([r * 40 for r in v], 1e-5)
+    assert via_curve == direct
+
+
+def test_resume_composes_heterogeneous_rdp(tmp_path):
+    """Resuming a DP checkpoint with a DIFFERENT noise multiplier must
+    charge the pre-resume rounds at the rate they were actually noised
+    with (restored RDP curve), never at the new config's rate — the
+    under-reporting hole review r3 found."""
+    from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                               RunConfig, ShardConfig)
+    from fedtpu.ops.dp_accountant import epsilon_from_rdp, rdp_vector
+    from fedtpu.orchestration.loop import run_experiment
+
+    ck = str(tmp_path / "ck")
+    base = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=128),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=4, weighting="uniform", dp_clip_norm=1.0,
+                      dp_noise_multiplier=0.2),
+        run=RunConfig(checkpoint_dir=ck, checkpoint_every=1),
+    )
+    first = run_experiment(base, verbose=False)
+    assert first.rounds_run == 4
+
+    # Resume for 4 more rounds at 10x the noise.
+    resumed_cfg = dataclasses.replace(
+        base, fed=dataclasses.replace(base.fed, rounds=8,
+                                      dp_noise_multiplier=2.0))
+    res = run_experiment(resumed_cfg, verbose=False, resume=True)
+    assert res.rounds_run == 8
+    dp = res.privacy_spent()
+    assert "resume_rdp" not in dp  # the curve was recorded, not assumed
+
+    v_low = np.asarray(rdp_vector(1.0, 0.2))   # rounds 1-4, sigma=0.2
+    v_high = np.asarray(rdp_vector(1.0, 2.0))  # rounds 5-8, sigma=2.0
+    exact = epsilon_from_rdp(list(4 * v_low + 4 * v_high), 1e-5)["epsilon"]
+    np.testing.assert_allclose(dp["epsilon"], exact, rtol=1e-12)
+    # The naive (all-8-rounds-at-current-sigma) epsilon is far SMALLER —
+    # exactly the under-report the composition prevents.
+    naive = privacy_spent(1.0, 2.0, 8, 1e-5)["epsilon"]
+    assert dp["epsilon"] > 3 * naive
+
+
+def test_noise_off_resume_segment_voids_the_guarantee(tmp_path):
+    """Rounds trained with noise OFF after noised rounds are NOT
+    post-processing — they re-access the private data, so the released
+    model has no finite (epsilon, delta). The accountant must report
+    epsilon=inf with a reason, never the earlier segments' finite spend,
+    and the void must survive later resumes (flags persist in the
+    checkpoint meta — review r3)."""
+    from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                               RunConfig, ShardConfig)
+    from fedtpu.orchestration.loop import run_experiment
+
+    ck = str(tmp_path / "ck")
+    base = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=128),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=3, weighting="uniform", dp_clip_norm=1.0,
+                      dp_noise_multiplier=1.0),
+        run=RunConfig(checkpoint_dir=ck, checkpoint_every=1),
+    )
+    a = run_experiment(base, verbose=False)
+    assert math.isfinite(a.privacy_spent()["epsilon"])
+    assert not a.dp_guarantee_void
+
+    # Segment B: clip stays (same state structure), noise OFF — trains 2
+    # more rounds on the private data without noise.
+    b_cfg = dataclasses.replace(
+        base, fed=dataclasses.replace(base.fed, rounds=5,
+                                      dp_noise_multiplier=0.0))
+    b = run_experiment(b_cfg, verbose=False, resume=True)
+    dp_b = b.privacy_spent()
+    assert b.dp_guarantee_void
+    assert math.isinf(dp_b["epsilon"])
+    assert "guarantee_void" in dp_b
+
+    # Segment C: noise back on — the void is sticky (persisted), no
+    # later segment can launder the epsilon back to finite.
+    c_cfg = dataclasses.replace(
+        base, fed=dataclasses.replace(base.fed, rounds=7,
+                                      dp_noise_multiplier=1.0))
+    c = run_experiment(c_cfg, verbose=False, resume=True)
+    dp_c = c.privacy_spent()
+    assert c.dp_guarantee_void and math.isinf(dp_c["epsilon"])
+
+    # Control: a fresh DP run that merely COMPLETES (no unnoised rounds)
+    # stays finite, and a noiseless-from-scratch run still claims nothing.
+    assert "dp" in a.summary()
+    plain = dataclasses.replace(
+        base,
+        fed=dataclasses.replace(base.fed, dp_noise_multiplier=0.0),
+        run=RunConfig())
+    assert "dp" not in run_experiment(plain, verbose=False).summary()
