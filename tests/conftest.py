@@ -33,7 +33,7 @@ import pytest  # noqa: E402
 # r2 selection had crept to 2:42 and was re-profiled with --durations and
 # trimmed), at least one test from EVERY in-process test module (so a
 # quick run still touches every fedtpu subsystem; the two subprocess
-# modules are excluded by name below). The full suite (~255 tests, ~22
+# modules are excluded by name below). The full suite (259 tests, ~25
 # min on this box) remains the merge gate; the quick tier is the
 # inner-loop iteration gate. Names,
 # not patterns, so a typo'd or gone-stale entry fails loudly via the
