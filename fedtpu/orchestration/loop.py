@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 from typing import Callable, Dict, List, Optional
 
@@ -167,7 +168,6 @@ class ExperimentResult:
             # Unnoised rounds re-trained on the private data after the
             # noised ones — NOT post-processing: no finite (eps, delta)
             # holds for the released model, whatever was spent before.
-            import math
             out["epsilon"] = math.inf
             out["rdp_order"] = None
             out["guarantee_void"] = ("rounds trained with noise off "
@@ -552,6 +552,15 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         return bool(dp_void_base
                     or (trained_unnoised and np.any(dp_rdp_base > 0)))
 
+    def dp_extra_meta(round_label: int) -> dict:
+        """The DP bookkeeping persisted with every checkpoint (periodic
+        and quarantine) — one definition so the two save sites can't
+        drift."""
+        return {"dp_rdp": dp_rdp_at(round_label),
+                "dp_rdp_orders": np.asarray(DEFAULT_ORDERS),
+                "dp_rdp_assumed": dp_base_assumed,
+                "dp_guarantee_void": dp_void_at(round_label)}
+
     history = {k: [] for k in METRIC_NAMES}
     pooled_hist = {k: [] for k in METRIC_NAMES}
     per_client_hist = {k: [] for k in METRIC_NAMES}
@@ -594,10 +603,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             save_checkpoint(
                 os.path.join(cfg.run.checkpoint_dir, "diverged"),
                 state, history, label_round,
-                extra_meta={"dp_rdp": dp_rdp_at(label_round),
-                            "dp_rdp_orders": np.asarray(DEFAULT_ORDERS),
-                            "dp_rdp_assumed": dp_base_assumed,
-                            "dp_guarantee_void": dp_void_at(label_round)})
+                extra_meta=dp_extra_meta(label_round))
         stopped_early = True
         diverged = True
 
@@ -830,12 +836,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # deadlocks), and it writes each client shard from the
                 # process that owns it (true distributed checkpointing).
                 save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd,
-                                extra_meta={
-                                    "dp_rdp": dp_rdp_at(rnd),
-                                    "dp_rdp_orders":
-                                        np.asarray(DEFAULT_ORDERS),
-                                    "dp_rdp_assumed": dp_base_assumed,
-                                    "dp_guarantee_void": dp_void_at(rnd)})
+                                extra_meta=dp_extra_meta(rnd))
 
         if pending is not None and not stopped_early:
             process_chunk(*pending, state_round=rnd)

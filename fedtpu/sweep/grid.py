@@ -98,25 +98,25 @@ def _build_sweep_fn(mesh, num_classes: int, local_steps: int, optim_cfg,
                     mask.sum().astype(jnp.float32), 1.0)
             return loss
 
-        def step(carry, _):
-            p, s, best, no_imp, active, steps = carry
-            loss, grads = jax.value_and_grad(loss_fn)(p)
-            updates, s_new = base.update(grads, s)
-            p_new = jax.tree.map(lambda a, u: a - lr * u, p, updates)
-            # Epoch runs only while active; a stopped pair's whole carry
-            # freezes (params, moments, and the plateau bookkeeping).
-            keep = lambda new, old: jax.tree.map(
-                lambda a, b: jnp.where(active, a, b), new, old)
-            p, s = keep(p_new, p), keep(s_new, s)
-            worse = loss > best - tol
-            no_imp = jnp.where(active,
-                               jnp.where(worse, no_imp + 1, 0), no_imp)
-            best = jnp.where(active, jnp.minimum(best, loss), best)
-            steps = steps + active.astype(jnp.int32)
-            active = active & (no_imp <= n_iter_no_change)
-            return (p, s, best, no_imp, active, steps), None
-
         if plateau_stop:
+            def step(carry, _):
+                p, s, best, no_imp, active, steps = carry
+                loss, grads = jax.value_and_grad(loss_fn)(p)
+                updates, s_new = base.update(grads, s)
+                p_new = jax.tree.map(lambda a, u: a - lr * u, p, updates)
+                # Epoch runs only while active; a stopped pair's whole
+                # carry freezes (params, moments, plateau bookkeeping).
+                keep = lambda new, old: jax.tree.map(
+                    lambda a, b: jnp.where(active, a, b), new, old)
+                p, s = keep(p_new, p), keep(s_new, s)
+                worse = loss > best - tol
+                no_imp = jnp.where(active,
+                                   jnp.where(worse, no_imp + 1, 0), no_imp)
+                best = jnp.where(active, jnp.minimum(best, loss), best)
+                steps = steps + active.astype(jnp.int32)
+                active = active & (no_imp <= n_iter_no_change)
+                return (p, s, best, no_imp, active, steps), None
+
             # The bookkeeping scalars must enter the scan carry already
             # marked clients-varying (the loss they get compared to is
             # computed from the client's shard), or shard_map rejects the

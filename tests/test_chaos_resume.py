@@ -14,6 +14,7 @@ complete one.
 
 import json
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -69,7 +70,6 @@ def test_sigkill_mid_training_then_resume_matches_uninterrupted(tmp_path):
     # inherently a wall-clock race against the child finishing; up to 3
     # attempts absorb a lost race on a descheduled box instead of flaking.
     for attempt in range(3):
-        import shutil
         if os.path.isdir(ck_b):
             shutil.rmtree(ck_b)
         proc = subprocess.Popen(_cmd(ck_b), env=_env(),
