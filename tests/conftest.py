@@ -28,15 +28,17 @@ assert len(jax.devices()) == 8, (
 import pytest  # noqa: E402
 
 # ---------------------------------------------------------------- quick tier
-# `pytest -m quick` — the CI-fast tier (VERDICT r1 item 7): < 2 min
-# (round-3 re-tune: measured 110 s on the 1-core verification box; the
-# r2 selection had crept to 2:42 and was re-profiled with --durations and
-# trimmed), at least one test from EVERY in-process test module (so a
-# quick run still touches every fedtpu subsystem; the two subprocess
-# modules are excluded by name below). The full suite (259 tests, ~25
-# min on this box) remains the merge gate; the quick tier is the
-# inner-loop iteration gate. Names,
-# not patterns, so a typo'd or gone-stale entry fails loudly via the
+# `pytest -m quick` — the CI-fast tier (VERDICT r1 item 7). Round-3
+# re-tune: the r2 selection had crept to 2:42 on this box and was
+# re-profiled with --durations and trimmed twice; measured 110 s on the
+# quiet 1-core verification box, up to ~2:15 when the box is contended
+# (the spread is host load, not the selection — the same set varied
+# 110-134 s across one afternoon). At least one test from EVERY
+# in-process test module (so a quick run still touches every fedtpu
+# subsystem; the two subprocess modules are excluded by name below).
+# The full suite (259 tests, ~25 min on this box) remains the merge
+# gate; the quick tier is the inner-loop iteration gate. Names, not
+# patterns, so a typo'd or gone-stale entry fails loudly via the
 # consistency guards at the bottom of pytest_collection_modifyitems
 # below.
 QUICK_TESTS = {
@@ -88,7 +90,6 @@ QUICK_TESTS = {
     "test_dryrun_after_backend_init_without_flag_raises_cleanly",
     "test_loop.py::test_run_experiment_history_shapes",
     "test_metrics.py::test_metrics_match_sklearn[2-0]",
-    "test_metrics.py::test_metrics_match_sklearn[5-2]",
     "test_metrics.py::test_zero_division_semantics",
     "test_metrics.py::test_mask_excludes_padding",
     "test_metrics.py::test_summed_confusions_equal_concatenated_predictions",
@@ -99,10 +100,8 @@ QUICK_TESTS = {
     "test_optim.py::test_adam_steplr_matches_torch_trajectory",
     "test_optim.py::test_schedule_staircase_boundaries",
     "test_optim.py::test_onehot_ce_equals_gather_ce",
-    "test_pallas.py::test_fused_mlp_matches_xla_apply",
     "test_pallas.py::test_weighted_average_kernel_matches_numpy",
     "test_parity.py::test_limitation_demonstrated",
-    "test_participation.py::test_sampling_is_deterministic_in_seed",
     "test_participation.py::test_sampled_average_over_participants_only",
     "test_personalize.py::test_personalize_rejects_zero_steps",
     "test_pipelined_stop.py::test_pipelined_divergence_still_halts",
@@ -112,7 +111,6 @@ QUICK_TESTS = {
     "test_ring.py::test_ring_matches_global_sum"
     "[shape0-ring_all_reduce_sum_rsag]",
     "test_ring.py::test_pallas_rdma_ring_matches_global_sum[shape0]",
-    "test_ring.py::test_pallas_ring_capacity_credits_balance[8]",
     "test_robust.py::test_median_matches_numpy_oracle",
     "test_robust.py::test_trimmed_mean_matches_numpy_oracle",
     "test_robust.py::test_krum_matches_numpy_oracle",
