@@ -115,12 +115,14 @@ def bench_fedtpu(ds) -> dict:
         # fetch at the end (the fixed-rounds production shape — run N
         # chunks, read results at the end). Dispatch overlaps compute.
         # timed_rounds is the mandatory harness: fetch-forced window +
-        # flops-floor check. Several independent windows per rps: dispatch
+        # flops-floor check. Multiple independent windows per rps: dispatch
         # jitter on the tunneled transport is ~±15%, and recording a single
         # window lets the artifact quote the top of its own jitter band
-        # (review r2) — report the median and keep the band.
+        # (review r2) — report the median and keep the band. The headline
+        # gets 5 windows; every other row gets 2, so no row ever records a
+        # degenerate zero-width band (advisor r3).
         n_calls = max(3, min(20, 2000 // rps))
-        reps = 5 if rps == HEADLINE_RPS else 1
+        reps = 5 if rps == HEADLINE_RPS else 2
         samples = []
         for _ in range(reps):
             sec_rep, state, metrics = timed_rounds(

@@ -48,6 +48,7 @@ from fedtpu.models import build_model
 from fedtpu.ops import build_optimizer
 from fedtpu.ops.metrics import METRIC_NAMES
 from fedtpu.orchestration.checkpoint import save_checkpoint
+from fedtpu.orchestration.privacy import PrivacyLedger
 from fedtpu.parallel.mesh import make_mesh, client_sharding
 from fedtpu.parallel.round import (build_round_fn, build_eval_fn,
                                    init_federated_state, global_params)
@@ -478,88 +479,13 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                           "carried over, fresh client optimizer state).",
                           flush=True)
 
-    # DP RDP bookkeeping: the cumulative per-order RDP curve is the
-    # resumable currency of the privacy spend (RDP composes additively,
-    # so a resume that CHANGES noise multiplier or sampling rate still
-    # accounts every round at the rate it was actually noised with —
-    # review r3: charging all rounds at the current config's rate would
-    # under-report epsilon, the unsafe direction). Maintained and
-    # persisted in every checkpoint's meta item UNCONDITIONALLY (a zero
-    # curve while DP is off), so a DP-off resume segment carries the
-    # earlier segments' spend forward instead of silently destroying it.
-    from fedtpu.ops.dp_accountant import DEFAULT_ORDERS, rdp_vector
-    dp_per_step = (np.asarray(rdp_vector(cfg.fed.participation_rate,
-                                         cfg.fed.dp_noise_multiplier))
-                   if cfg.fed.dp_noise_multiplier > 0
-                   else np.zeros(len(DEFAULT_ORDERS)))
-    dp_rdp_base = np.zeros(len(DEFAULT_ORDERS))
-    dp_base_assumed = False
-    dp_void_base = False
-    if start_round > 0:
-        meta_d = restored_meta or {}
-        # Both honesty flags persist WITH the curve and OR forward — once
-        # a segment's accounting was assumed (pre-r3 checkpoint) or its
-        # guarantee voided (unnoised rounds below), no later resume may
-        # silently launder the epsilon back to "clean".
-        dp_base_assumed = bool(np.asarray(
-            meta_d.get("dp_rdp_assumed", False)))
-        dp_void_base = bool(np.asarray(
-            meta_d.get("dp_guarantee_void", False)))
-        saved_rdp = meta_d.get("dp_rdp")
-        saved_orders = meta_d.get("dp_rdp_orders")
-        if saved_rdp is not None:
-            saved_rdp = np.asarray(saved_rdp, dtype=np.float64)
-            if saved_orders is None and len(saved_rdp) == len(dp_per_step):
-                # Same-era checkpoint without the orders array: the grid
-                # length matching today's is the best available identity
-                # evidence.
-                dp_rdp_base = saved_rdp
-            elif saved_orders is not None:
-                # Re-project the saved curve onto today's order grid by
-                # ORDER VALUE, so a grid change between versions never
-                # discards the spend. Orders the old curve lacks get +inf
-                # — they drop out of the epsilon minimization, which can
-                # only LOOSEN epsilon (the safe direction).
-                by_order = dict(zip((int(o) for o in
-                                     np.asarray(saved_orders)), saved_rdp))
-                dp_rdp_base = np.asarray(
-                    [by_order.get(int(o), np.inf) for o in DEFAULT_ORDERS])
-            else:
-                # Unidentifiable grid: the spend exists but cannot be
-                # attributed per order — assume the current rate, flagged.
-                dp_rdp_base = dp_per_step * start_round
-                dp_base_assumed = True
-        elif cfg.fed.dp_noise_multiplier > 0:
-            # Pre-r3 checkpoint without the curve under a DP config: the
-            # only available assumption is the current config's rate —
-            # flagged in the report so the epsilon is never silently
-            # wrong. (Without DP on, a missing curve stays zero: the
-            # pre-r3 non-DP behavior, not a claim.)
-            dp_rdp_base = dp_per_step * start_round
-            dp_base_assumed = True
-
-    def dp_rdp_at(round_label: int):
-        """Cumulative RDP curve when the state is at ``round_label``."""
-        return dp_rdp_base + dp_per_step * max(0, round_label - start_round)
-
-    def dp_void_at(round_label: int) -> bool:
-        """True when the released model has NO (epsilon, delta) guarantee
-        despite a nonzero spend: some rounds after the noised ones
-        re-trained on the private data with the noise OFF (that is not
-        post-processing — it voids the guarantee; review r3)."""
-        trained_unnoised = (cfg.fed.dp_noise_multiplier <= 0
-                            and round_label > start_round)
-        return bool(dp_void_base
-                    or (trained_unnoised and np.any(dp_rdp_base > 0)))
-
-    def dp_extra_meta(round_label: int) -> dict:
-        """The DP bookkeeping persisted with every checkpoint (periodic
-        and quarantine) — one definition so the two save sites can't
-        drift."""
-        return {"dp_rdp": dp_rdp_at(round_label),
-                "dp_rdp_orders": np.asarray(DEFAULT_ORDERS),
-                "dp_rdp_assumed": dp_base_assumed,
-                "dp_guarantee_void": dp_void_at(round_label)}
+    # DP RDP bookkeeping lives in its own module (fedtpu.orchestration.
+    # privacy): the cumulative per-order RDP curve is the resumable
+    # currency of the privacy spend, persisted in every checkpoint's meta
+    # item UNCONDITIONALLY (a zero curve while DP is off) so a DP-off
+    # resume segment carries the earlier segments' spend forward.
+    ledger = PrivacyLedger(cfg.fed, start_round=start_round,
+                           restored_meta=restored_meta)
 
     history = {k: [] for k in METRIC_NAMES}
     pooled_hist = {k: [] for k in METRIC_NAMES}
@@ -603,7 +529,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             save_checkpoint(
                 os.path.join(cfg.run.checkpoint_dir, "diverged"),
                 state, history, label_round,
-                extra_meta=dp_extra_meta(label_round))
+                extra_meta=ledger.checkpoint_meta(label_round))
         stopped_early = True
         diverged = True
 
@@ -836,7 +762,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # deadlocks), and it writes each client shard from the
                 # process that owns it (true distributed checkpointing).
                 save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd,
-                                extra_meta=dp_extra_meta(rnd))
+                                extra_meta=ledger.checkpoint_meta(rnd))
 
         if pending is not None and not stopped_early:
             process_chunk(*pending, state_round=rnd)
@@ -909,12 +835,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         # released params trained through (> rounds_run after a pipelined
         # early stop's overshoot chunk; the DP accountant must count it).
         rounds_trained=int(np.asarray(jax.device_get(_rep(state["round"])))),
-        dp_base_assumed=dp_base_assumed,
+        dp_base_assumed=ledger.base_assumed,
     )
     result = dataclasses.replace(
-        result, dp_rdp_total=dp_rdp_at(result.rounds_trained),
-        dp_guarantee_void=dp_void_at(result.rounds_trained),
-        dp_composed=bool(np.any(dp_rdp_base > 0)))
+        result, dp_rdp_total=ledger.rdp_at(result.rounds_trained),
+        dp_guarantee_void=ledger.void_at(result.rounds_trained),
+        dp_composed=ledger.composed)
     if verbose:
         dp = result.privacy_spent()
         if dp:

@@ -105,6 +105,12 @@ QUICK_TESTS = {
     "test_participation.py::test_sampled_average_over_participants_only",
     "test_personalize.py::test_personalize_rejects_zero_steps",
     "test_pipelined_stop.py::test_pipelined_divergence_still_halts",
+    "test_privacy_ledger.py::test_checkpoint_meta_roundtrips_exactly",
+    "test_privacy_ledger.py::test_zero_order_overlap_projects_finite_not_inf",
+    "test_privacy_ledger.py::test_noise_off_resume_never_zeroes"
+    "_restored_spend",
+    "test_privacy_ledger.py::test_guarantee_void_when_training_unnoised"
+    "_after_noised",
     "test_review_fixes.py::test_numeric_labels_reencoded_to_contiguous_indices",
     "test_review_fixes.py::test_empty_shards_excluded_from_client_mean",
     "test_ring.py::test_ring_matches_global_sum[shape0-ring_all_reduce_sum]",

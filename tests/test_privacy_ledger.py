@@ -1,0 +1,218 @@
+"""Unit tests for fedtpu.orchestration.privacy.PrivacyLedger — the DP
+RDP bookkeeping extracted from run_experiment (VERDICT r3 #8). The
+end-to-end resume-composition behavior is pinned through run_experiment
+in test_dp_accountant (test_resume_composes_heterogeneous_rdp,
+test_noise_off_resume_segment_voids_the_guarantee); these tests pin the
+ledger in isolation, including the advisor-r3 zero-order-overlap
+projection."""
+
+import math
+
+import numpy as np
+
+from fedtpu.config import FedConfig
+from fedtpu.ops.dp_accountant import (DEFAULT_ORDERS, epsilon_from_rdp,
+                                      rdp_vector)
+from fedtpu.orchestration.privacy import PrivacyLedger
+
+
+def _fed(**kw) -> FedConfig:
+    base = dict(dp_clip_norm=1.0, dp_noise_multiplier=1.1,
+                participation_rate=1.0)
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def test_fresh_run_accumulates_per_step():
+    led = PrivacyLedger(_fed())
+    per_step = np.asarray(rdp_vector(1.0, 1.1))
+    np.testing.assert_allclose(led.rdp_at(7), per_step * 7)
+    assert not led.base_assumed and not led.composed
+    assert not led.void_at(7)
+
+
+def test_noise_off_fresh_run_is_zero_curve():
+    led = PrivacyLedger(_fed(dp_noise_multiplier=0.0, dp_clip_norm=0.0))
+    assert np.all(led.rdp_at(100) == 0)
+    meta = led.checkpoint_meta(100)
+    # Persisted UNCONDITIONALLY (zero curve while DP is off) so a later
+    # DP segment composes on top instead of guessing.
+    assert np.all(np.asarray(meta["dp_rdp"]) == 0)
+    assert not meta["dp_guarantee_void"]
+
+
+def test_checkpoint_meta_roundtrips_exactly():
+    led = PrivacyLedger(_fed())
+    meta = led.checkpoint_meta(5)
+    led2 = PrivacyLedger(_fed(dp_noise_multiplier=2.0), start_round=5,
+                         restored_meta=meta)
+    # Segment 2 charges its own sigma per round ON TOP of the restored
+    # curve — exact heterogeneous composition.
+    expect = (np.asarray(rdp_vector(1.0, 1.1)) * 5
+              + np.asarray(rdp_vector(1.0, 2.0)) * 3)
+    np.testing.assert_allclose(led2.rdp_at(8), expect)
+    assert led2.composed and not led2.base_assumed
+
+
+def test_same_length_curve_without_orders_is_trusted():
+    saved = np.asarray(rdp_vector(1.0, 1.1)) * 4
+    led = PrivacyLedger(_fed(), start_round=4,
+                        restored_meta={"dp_rdp": saved})
+    np.testing.assert_allclose(led.base, saved)
+    assert not led.base_assumed
+
+
+def test_partial_order_overlap_projects_monotone_upper_bound():
+    # Old grid = today's grid minus its first order: surviving orders
+    # project exactly; the missing smallest order gets the NEXT saved
+    # order's value (Renyi divergence is non-decreasing in the order, so
+    # that's a safe upper bound — never an under-report).
+    old_orders = np.asarray(DEFAULT_ORDERS[1:])
+    old_curve = np.linspace(0.1, 1.0, len(old_orders))
+    led = PrivacyLedger(_fed(), start_round=3,
+                        restored_meta={"dp_rdp": old_curve,
+                                       "dp_rdp_orders": old_orders})
+    assert led.base[0] == old_curve[0]
+    np.testing.assert_allclose(led.base[1:], old_curve)
+    assert not led.base_assumed
+    assert math.isfinite(
+        epsilon_from_rdp(list(led.rdp_at(3)), 1e-5)["epsilon"])
+
+
+def test_orders_above_saved_max_drop_out_as_inf():
+    # A saved grid covering only small orders: today's larger orders
+    # cannot be bounded from it and get +inf — they drop out of the
+    # epsilon minimization, which can only loosen epsilon.
+    old_orders = np.asarray([2, 3, 4])
+    old_curve = np.asarray([0.1, 0.2, 0.3])
+    led = PrivacyLedger(_fed(), start_round=3,
+                        restored_meta={"dp_rdp": old_curve,
+                                       "dp_rdp_orders": old_orders})
+    np.testing.assert_allclose(led.base[:3], old_curve)
+    assert np.all(np.isinf(led.base[3:]))
+    assert not led.base_assumed
+    assert math.isfinite(
+        epsilon_from_rdp(list(led.rdp_at(3)), 1e-5)["epsilon"])
+
+
+def test_zero_order_overlap_projects_finite_not_inf():
+    # Advisor r3: a disjoint order grid used to project to an all-inf
+    # curve — epsilon=inf with no flag, indistinguishable from a
+    # genuinely infinite spend. Monotonicity bounds every one of today's
+    # orders by the smallest saved value at a LARGER order, so the
+    # projection stays finite with no assumption at all.
+    foreign_orders = np.asarray([1000, 2000, 3000])
+    foreign_curve = np.asarray([0.5, 0.7, 0.9])
+    led = PrivacyLedger(_fed(), start_round=6,
+                        restored_meta={"dp_rdp": foreign_curve,
+                                       "dp_rdp_orders": foreign_orders})
+    np.testing.assert_allclose(led.base, np.full(led.base.shape, 0.5))
+    assert not led.base_assumed
+    assert math.isfinite(
+        epsilon_from_rdp(list(led.rdp_at(6)), 1e-5)["epsilon"])
+
+
+def test_noise_off_resume_never_zeroes_restored_spend():
+    # Review r4 (laundering): resuming with noise OFF from a foreign-grid
+    # curve with positive spend must preserve the spend — base stays
+    # positive, void_at fires once unnoised rounds train, and the
+    # persisted meta keeps both.
+    led = PrivacyLedger(_fed(dp_noise_multiplier=0.0, dp_clip_norm=0.0),
+                        start_round=6,
+                        restored_meta={"dp_rdp": [0.5, 0.7, 0.9],
+                                       "dp_rdp_orders": [1000, 2000, 3000]})
+    assert np.any(led.base > 0)
+    assert led.composed
+    assert led.void_at(10)
+    meta = led.checkpoint_meta(10)
+    assert np.any(np.asarray(meta["dp_rdp"]) > 0)
+    assert meta["dp_guarantee_void"]
+
+
+def test_mismatched_curve_and_orders_lengths_degrades_not_crashes():
+    # Cross-version or partially-written meta: len(dp_rdp) !=
+    # len(dp_rdp_orders). No per-order attribution is trustworthy —
+    # resume must degrade to the unattributable path, not IndexError.
+    led = PrivacyLedger(_fed(), start_round=4,
+                        restored_meta={"dp_rdp": np.asarray([0.1, 0.2, 0.3]),
+                                       "dp_rdp_orders": np.asarray([2, 3])})
+    np.testing.assert_allclose(led.base,
+                               np.asarray(rdp_vector(1.0, 1.1)) * 4)
+    assert led.base_assumed
+
+
+def test_unattributable_spend_with_noise_off_is_inf_and_flagged():
+    # Unidentifiable grid (no orders array, length mismatch) with noise
+    # off: no rate to assume and nothing to project — the spend is
+    # carried as +inf (over-report, the safe direction), flagged so the
+    # report distinguishes it from a genuinely infinite spend.
+    led = PrivacyLedger(_fed(dp_noise_multiplier=0.0, dp_clip_norm=0.0),
+                        start_round=4,
+                        restored_meta={"dp_rdp": np.asarray([0.1, 0.2])})
+    assert np.all(np.isinf(led.base))
+    assert led.base_assumed
+    assert led.void_at(5)
+
+
+def test_zero_order_overlap_with_zero_spend_stays_exact():
+    # An all-zero curve is zero spend on ANY grid — no assumption needed
+    # even when no order matches.
+    led = PrivacyLedger(_fed(), start_round=6,
+                        restored_meta={"dp_rdp": np.zeros(3),
+                                       "dp_rdp_orders": [1000, 2000, 3000]})
+    assert np.all(led.base == 0)
+    assert not led.base_assumed
+
+
+def test_unidentifiable_grid_assumes_current_rate():
+    # Curve present, no orders array, length != today's grid: the spend
+    # exists but cannot be attributed per order.
+    led = PrivacyLedger(_fed(), start_round=4,
+                        restored_meta={"dp_rdp": np.asarray([0.1, 0.2])})
+    np.testing.assert_allclose(led.base,
+                               np.asarray(rdp_vector(1.0, 1.1)) * 4)
+    assert led.base_assumed
+
+
+def test_pre_r3_checkpoint_without_curve():
+    # Under a DP config the pre-resume rounds are charged at the current
+    # rate, flagged; without DP a missing curve is simply zero.
+    led = PrivacyLedger(_fed(), start_round=9, restored_meta={})
+    np.testing.assert_allclose(led.base,
+                               np.asarray(rdp_vector(1.0, 1.1)) * 9)
+    assert led.base_assumed
+    led_off = PrivacyLedger(_fed(dp_noise_multiplier=0.0, dp_clip_norm=0.0),
+                            start_round=9, restored_meta={})
+    assert np.all(led_off.base == 0) and not led_off.base_assumed
+
+
+def test_guarantee_void_when_training_unnoised_after_noised():
+    noised = PrivacyLedger(_fed())
+    meta = noised.checkpoint_meta(5)
+    cont = PrivacyLedger(_fed(dp_noise_multiplier=0.0, dp_clip_norm=0.0),
+                         start_round=5, restored_meta=meta)
+    # At the resume point itself nothing unnoised has trained yet.
+    assert not cont.void_at(5)
+    assert cont.void_at(6)
+    # And the flag is sticky through a further checkpoint/resume cycle,
+    # even under a noised continuation.
+    meta2 = cont.checkpoint_meta(7)
+    led3 = PrivacyLedger(_fed(), start_round=7, restored_meta=meta2)
+    assert led3.void_at(7) and led3.void_at(20)
+
+
+def test_assumed_flag_is_sticky_across_resumes():
+    led = PrivacyLedger(_fed(), start_round=4,
+                        restored_meta={"dp_rdp": np.asarray([0.1, 0.2])})
+    assert led.base_assumed
+    meta = led.checkpoint_meta(8)
+    led2 = PrivacyLedger(_fed(), start_round=8, restored_meta=meta)
+    assert led2.base_assumed
+
+
+def test_sampling_rate_enters_per_step():
+    full = PrivacyLedger(_fed(participation_rate=1.0))
+    sub = PrivacyLedger(_fed(participation_rate=0.25))
+    # Subsampling amplifies privacy: the subsampled curve is strictly
+    # below full participation at every order.
+    assert np.all(sub.per_step < full.per_step)
