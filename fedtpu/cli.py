@@ -130,6 +130,9 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    help="evaluate with the Pallas fused-MLP kernel")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=None)
+    p.add_argument("--keep-checkpoints", type=int, default=None,
+                   help="retain only the k newest complete checkpoints "
+                        "plus the best-accuracy round (0 = keep all)")
     p.add_argument("--eval-test-every", type=int, default=None)
     p.add_argument("--rounds-per-step", type=int, default=None,
                    help="rounds scanned per compiled step (throughput knob)")
@@ -226,6 +229,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["checkpoint_dir"] = args.checkpoint_dir
     if args.checkpoint_every is not None:
         run_kw["checkpoint_every"] = args.checkpoint_every
+    if args.keep_checkpoints is not None:
+        run_kw["keep_checkpoints"] = args.keep_checkpoints
     if args.eval_test_every is not None:
         run_kw["eval_test_every"] = args.eval_test_every
     if args.rounds_per_step is not None:

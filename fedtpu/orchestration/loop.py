@@ -47,7 +47,9 @@ from fedtpu.data.tabular import Dataset
 from fedtpu.models import build_model
 from fedtpu.ops import build_optimizer
 from fedtpu.ops.metrics import METRIC_NAMES
-from fedtpu.orchestration.checkpoint import save_checkpoint
+from fedtpu.orchestration.checkpoint import (complete_steps,
+                                             retain_checkpoints,
+                                             save_checkpoint)
 from fedtpu.orchestration.privacy import PrivacyLedger
 from fedtpu.parallel.mesh import make_mesh, client_sharding
 from fedtpu.parallel.round import (build_round_fn, build_eval_fn,
@@ -540,6 +542,36 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             prev_metric = [history[k][-1] for k in METRIC_NAMES]
         rounds_run = start_round
 
+    # Checkpoint retention (RunConfig.keep_checkpoints > 0): after every
+    # periodic save, keep only the k newest complete rounds plus the
+    # best-client-mean-accuracy round. ``best_saved`` tracks (accuracy,
+    # step) over the checkpoints THIS run wrote; on resume it re-seeds
+    # from the rounds still on disk and the restored history, so a
+    # resumed run never GCs a better pre-resume round. Derived from
+    # replicated metrics, so it is identical on every process; only
+    # io_proc deletes (orbax save has barriered by then, so every round
+    # being deleted is fully committed).
+    best_saved = None
+    if (cfg.run.keep_checkpoints > 0 and cfg.run.checkpoint_dir
+            and restored_history is not None):
+        acc_hist = history["accuracy"]
+        for s in complete_steps(cfg.run.checkpoint_dir):
+            if 0 < s <= len(acc_hist) and (best_saved is None
+                                           or acc_hist[s - 1] > best_saved[0]):
+                best_saved = (acc_hist[s - 1], s)
+
+    def retain_after_save(step: int) -> None:
+        nonlocal best_saved
+        if cfg.run.keep_checkpoints <= 0:
+            return
+        acc = history["accuracy"][-1] if history["accuracy"] else -math.inf
+        if best_saved is None or acc > best_saved[0]:
+            best_saved = (acc, step)
+        if io_proc:
+            retain_checkpoints(cfg.run.checkpoint_dir,
+                               cfg.run.keep_checkpoints,
+                               protect=(best_saved[1],))
+
     ckpt_every = cfg.run.checkpoint_every
     chunk = max(1, cfg.run.rounds_per_step)
     step_fns: Dict[int, Callable] = {}
@@ -763,6 +795,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # process that owns it (true distributed checkpointing).
                 save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd,
                                 extra_meta=ledger.checkpoint_meta(rnd))
+                retain_after_save(rnd)
 
         if pending is not None and not stopped_early:
             process_chunk(*pending, state_round=rnd)

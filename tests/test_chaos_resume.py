@@ -35,13 +35,16 @@ HIDDEN = "32"
 KILL_AT_STEP = 2
 
 
-def _cmd(ckpt_dir):
-    return [sys.executable, "-m", "fedtpu.cli", "run",
-            "--csv", "", "--platform", "cpu",
-            "--rounds", str(ROUNDS), "--hidden-sizes", HIDDEN,
-            "--checkpoint-dir", ckpt_dir,
-            "--checkpoint-every", str(CKPT_EVERY),
-            "--quiet", "--json"]
+def _cmd(ckpt_dir, keep=None):
+    cmd = [sys.executable, "-m", "fedtpu.cli", "run",
+           "--csv", "", "--platform", "cpu",
+           "--rounds", str(ROUNDS), "--hidden-sizes", HIDDEN,
+           "--checkpoint-dir", ckpt_dir,
+           "--checkpoint-every", str(CKPT_EVERY),
+           "--quiet", "--json"]
+    if keep is not None:
+        cmd += ["--keep-checkpoints", str(keep)]
+    return cmd
 
 
 def _env():
@@ -72,7 +75,7 @@ def test_sigkill_mid_training_then_resume_matches_uninterrupted(tmp_path):
     for attempt in range(3):
         if os.path.isdir(ck_b):
             shutil.rmtree(ck_b)
-        proc = subprocess.Popen(_cmd(ck_b), env=_env(),
+        proc = subprocess.Popen(_cmd(ck_b, keep=2), env=_env(),
                                 stdout=subprocess.DEVNULL,
                                 stderr=subprocess.DEVNULL)
         try:
@@ -101,8 +104,12 @@ def test_sigkill_mid_training_then_resume_matches_uninterrupted(tmp_path):
     assert killed_at is not None
     assert killed_at < summary_a["rounds_run"]  # it really died mid-run
 
-    # Resume the killed run; it must finish the job.
-    summary_b = _run_to_completion(ck_b, extra=("--resume",))
+    # Resume the killed run; it must finish the job. The killed run and
+    # its resume both run under retention (--keep-checkpoints 2): a
+    # SIGKILL between a save and its GC, or mid-GC, must never leave a
+    # state resume can't use (VERDICT r3 #7).
+    summary_b = _run_to_completion(
+        ck_b, extra=("--resume", "--keep-checkpoints", "2"))
 
     # The headline assertion: metric history and final state of
     # (killed + resumed) are EXACTLY the uninterrupted run's.
@@ -113,6 +120,10 @@ def test_sigkill_mid_training_then_resume_matches_uninterrupted(tmp_path):
 
     step_a, step_b = latest_step(ck_a), latest_step(ck_b)
     assert step_a == step_b
+    # Retention bounded the killed+resumed run's disk: at most the 2
+    # newest rounds plus the protected best-accuracy round remain.
+    from fedtpu.orchestration.checkpoint import complete_steps
+    assert len(complete_steps(ck_b)) <= 3
     # Mirror the CLI's effective config (income-8 preset, --csv "" ->
     # synthetic data, --hidden-sizes 32) to build a state template.
     import dataclasses

@@ -140,3 +140,65 @@ def test_latest_step_skips_half_written_rounds(tmp_path):
     fake_round(4, ["state"])                         # killed before meta
     fake_round(6, ["state.orbax-checkpoint-tmp"])    # killed mid-state
     assert latest_step(str(tmp_path)) == 2
+
+
+def test_retention_keeps_k_newest_plus_protected(tmp_path):
+    from fedtpu.orchestration.checkpoint import (complete_steps, latest_step,
+                                                 retain_checkpoints)
+
+    def fake_round(step, items):
+        d = tmp_path / f"round_{step:06d}"
+        d.mkdir()
+        for name in items:
+            (d / name).mkdir()
+
+    for s in (2, 4, 6, 8, 10):
+        fake_round(s, ["state", "meta"])
+    fake_round(5, ["state"])                 # stale crash remnant: GC'd
+    fake_round(12, ["state"])                # could be mid-commit: untouched
+    removed = retain_checkpoints(str(tmp_path), keep=2, protect=(4,))
+    assert removed == [2, 5, 6]
+    assert complete_steps(str(tmp_path)) == [4, 8, 10]
+    assert latest_step(str(tmp_path)) == 10          # half-round still invisible
+    assert (tmp_path / "round_000012").is_dir()
+    assert not (tmp_path / "round_000005").exists()
+    # keep <= 0 keeps everything (the default).
+    assert retain_checkpoints(str(tmp_path), keep=0) == []
+    assert complete_steps(str(tmp_path)) == [4, 8, 10]
+
+
+def test_run_experiment_retention_bounds_disk_and_resumes(tmp_path):
+    # End-to-end: keep_checkpoints=2 with per-round saves must leave at
+    # most k+1 rounds on disk (k newest + the protected best-accuracy
+    # round), and a resume from the retained set must continue cleanly
+    # and keep honoring retention.
+    from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                               RunConfig, ShardConfig)
+    from fedtpu.orchestration.checkpoint import complete_steps
+    from fedtpu.orchestration.loop import run_experiment
+
+    def cfg(rounds):
+        return ExperimentConfig(
+            data=DataConfig(csv_path=None, synthetic_rows=256),
+            shard=ShardConfig(num_clients=4),
+            fed=FedConfig(rounds=rounds),
+            run=RunConfig(checkpoint_dir=str(tmp_path), checkpoint_every=1,
+                          keep_checkpoints=2),
+        )
+
+    res = run_experiment(cfg(6), verbose=False)
+    assert res.rounds_run == 6
+    steps = complete_steps(str(tmp_path))
+    assert len(steps) <= 3 and steps[-1] == 6
+    best_round = int(np.argmax(res.global_metrics["accuracy"])) + 1
+    assert best_round in steps
+
+    res2 = run_experiment(cfg(10), verbose=False, resume=True)
+    assert res2.rounds_run == 10
+    # The pre-resume history is carried over intact through retention.
+    np.testing.assert_allclose(res2.global_metrics["accuracy"][:6],
+                               res.global_metrics["accuracy"])
+    steps2 = complete_steps(str(tmp_path))
+    assert len(steps2) <= 3 and steps2[-1] == 10
+    best2 = int(np.argmax(res2.global_metrics["accuracy"])) + 1
+    assert best2 in steps2
