@@ -51,6 +51,18 @@ from fedtpu.training.client import make_local_train_step, make_local_eval_step
 # stream, which folds the round index directly into key(participation_seed)).
 _DP_NOISE_STREAM = 0x6E6F6973  # "nois"
 
+# Smoothed-Weiszfeld iteration budget for geometric_median. Fixed (not a
+# data-dependent stopping rule) so the scan stays compiler-friendly.
+# Measured convergence is linear at ~1e-2 relative step per iteration;
+# the slowest observed case (low-dimensional joint updates with a 25%
+# outlier cluster) reaches a 1e-7 relative step by ~13 iterations, and
+# high-dimensional (model-scale) cases converge faster — 16 leaves
+# margin at a cost of a few extra (C, dim) passes per round.
+# tests/test_robust.py::test_weiszfeld_iteration_budget_converges pins
+# both the monotone objective decrease (the Weiszfeld guarantee) and
+# stationarity within this budget at small AND model-scale dimensions.
+WEISZFELD_ITERS = 16
+
 
 def bcast_global(gl, p):
     """One global (clients-free) tensor into every client slot of ``p``'s
@@ -467,7 +479,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                         return ((wgt[:, None] * flat).sum(axis=0)
                                 / wgt.sum()), None
 
-                    mu, _ = jax.lax.scan(weiszfeld, mu, length=10)
+                    mu, _ = jax.lax.scan(weiszfeld, mu,
+                                         length=WEISZFELD_ITERS)
                     offsets = [0]
                     for l in leaves:
                         offsets.append(offsets[-1]
