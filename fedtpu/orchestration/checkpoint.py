@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import warnings
 from typing import Optional, Tuple
 
 import jax
@@ -88,22 +89,36 @@ def _is_complete(path: str) -> bool:
             and os.path.isdir(os.path.join(path, "meta")))
 
 
+def _step_of(name: str) -> Optional[int]:
+    """Step of a ``round_<N>`` directory name; None for anything else.
+    The ONE definition of what counts as a round dir — complete_steps
+    and the retention remnant sweep must agree on it."""
+    if not name.startswith("round_"):
+        return None
+    try:
+        return int(name.split("_")[1])
+    except (IndexError, ValueError):
+        return None
+
+
+def _scan_rounds(directory: str) -> list:
+    """All round dirs under ``directory`` as sorted (step, complete)
+    pairs — one listdir serving both the resume view and retention."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        step = _step_of(name)
+        if step is not None:
+            out.append((step, _is_complete(os.path.join(directory, name))))
+    return sorted(out)
+
+
 def complete_steps(directory: str) -> list:
     """Sorted steps of every COMPLETE checkpoint under ``directory``
     (half-written rounds from a crash are skipped — see
     ``_is_complete``)."""
-    if not os.path.isdir(directory):
-        return []
-    steps = []
-    for name in os.listdir(directory):
-        if name.startswith("round_"):
-            try:
-                step = int(name.split("_")[1])
-            except (IndexError, ValueError):
-                continue
-            if _is_complete(os.path.join(directory, name)):
-                steps.append(step)
-    return sorted(steps)
+    return [s for s, ok in _scan_rounds(directory) if ok]
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -129,28 +144,37 @@ def retain_checkpoints(directory: str, keep: int,
     called anywhere but right after a save, it could be a concurrent
     writer mid-commit. Multi-process: call from ONE process only (orbax
     save has already barriered, so every round being deleted is fully
-    committed)."""
+    committed).
+
+    GC is best-effort: a transient filesystem error deleting one round
+    (NFS silly-rename, an external reader holding a handle) warns and
+    skips that round rather than killing the training run — losing
+    wall-clock progress over disk GC would invert the priorities."""
     if keep <= 0:
         return []
-    steps = complete_steps(directory)
+    rounds = _scan_rounds(directory)
+    steps = [s for s, ok in rounds if ok]
     kept = set(steps[-keep:]) | {int(p) for p in protect}
     removed = []
+
+    def _rm(step):
+        try:
+            shutil.rmtree(_ckpt_path(directory, step))
+            removed.append(step)
+        except OSError as e:
+            warnings.warn(f"checkpoint retention: could not delete "
+                          f"round {step} ({e}); will retry after the "
+                          "next save", RuntimeWarning)
+
     for s in steps:
         if s not in kept:
-            shutil.rmtree(_ckpt_path(directory, s))
-            removed.append(s)
+            _rm(s)
     if steps:
-        for name in os.listdir(directory):
-            if not name.startswith("round_"):
-                continue
-            try:
-                s = int(name.split("_")[1])
-            except (IndexError, ValueError):
-                continue
-            path = os.path.join(directory, name)
-            if s < steps[-1] and not _is_complete(path):
-                shutil.rmtree(path)
-                removed.append(s)
+        # Incomplete dirs below the newest complete round are dead crash
+        # remnants (see docstring); at/above it they may be mid-commit.
+        for s, ok in rounds:
+            if not ok and s < steps[-1]:
+                _rm(s)
     return sorted(removed)
 
 

@@ -426,6 +426,21 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     start_round = 0
     restored_history = None
     restored_meta = None
+    if (not resume and cfg.run.checkpoint_dir and cfg.run.checkpoint_every
+            and complete_steps(cfg.run.checkpoint_dir)):
+        # A FRESH run into a directory already holding rounds is almost
+        # always a mistake, and actively dangerous: a later --resume (or
+        # crash-resume) would restore the STALE higher-numbered round
+        # over this run's work, and retention would treat the stale
+        # rounds as this run's newest and GC the fresh ones (review r4).
+        # Deleting another run's checkpoints uninvited would be worse —
+        # refuse with the two honest options instead.
+        raise ValueError(
+            f"checkpoint dir {cfg.run.checkpoint_dir!r} already holds "
+            f"round checkpoints (latest: "
+            f"{complete_steps(cfg.run.checkpoint_dir)[-1]}). Pass "
+            "resume=True (--resume) to continue that run, or point "
+            "checkpoint_dir at a clean directory.")
     if resume and cfg.run.checkpoint_dir:
         from fedtpu.orchestration.checkpoint import (
             latest_step, load_checkpoint, load_checkpoint_raw, load_meta,

@@ -202,3 +202,30 @@ def test_run_experiment_retention_bounds_disk_and_resumes(tmp_path):
     assert len(steps2) <= 3 and steps2[-1] == 10
     best2 = int(np.argmax(res2.global_metrics["accuracy"])) + 1
     assert best2 in steps2
+
+
+def test_fresh_run_refuses_dir_with_existing_rounds(tmp_path):
+    # A fresh (non-resume) periodic-checkpointing run into a directory
+    # already holding rounds would let a later resume restore the stale
+    # higher round over the new work, and retention would GC the fresh
+    # rounds (review r4) — refuse up front.
+    import pytest
+
+    from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                               RunConfig, ShardConfig)
+    from fedtpu.orchestration.loop import run_experiment
+
+    def cfg():
+        return ExperimentConfig(
+            data=DataConfig(csv_path=None, synthetic_rows=128),
+            shard=ShardConfig(num_clients=4),
+            fed=FedConfig(rounds=2),
+            run=RunConfig(checkpoint_dir=str(tmp_path), checkpoint_every=1),
+        )
+
+    run_experiment(cfg(), verbose=False)
+    with pytest.raises(ValueError, match="already holds"):
+        run_experiment(cfg(), verbose=False)
+    # resume=True remains the sanctioned way in.
+    res = run_experiment(cfg(), verbose=False, resume=True)
+    assert res.rounds_run == 2
