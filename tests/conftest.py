@@ -123,6 +123,7 @@ QUICK_TESTS = {
     "test_robust.py::test_krum_matches_numpy_oracle",
     "test_robust.py::test_geometric_median_matches_numpy_weiszfeld",
     "test_robust.py::test_robust_rejects_bad_combos",
+    "test_robust.py::test_weiszfeld_iteration_budget_converges",
     "test_round_smoke.py::test_empty_hidden_sizes_is_logistic_regression",
     "test_server_opt.py::test_update_rules_match_numpy_oracle",
     "test_server_opt.py::test_clip_by_global_norm_is_per_client_joint",
