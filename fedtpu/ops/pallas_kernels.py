@@ -14,9 +14,19 @@ are fedtpu's TPU-native equivalents for the two per-round hot paths:
   client block as a single (1,C)@(C,D) MXU contraction in VMEM (the in-kernel
   analogue of the rank-0 weighted average, FL_CustomMLP...:108-116).
 
-Both kernels are shape-generic (weights are small enough to live whole in
-VMEM; the row axis is gridded) and run in interpret mode on CPU, which is how
-the unit tests check bit-parity against the pure-XLA implementations.
+All kernels run in interpret mode on CPU, which is how the unit tests check
+bit-parity against the pure-XLA implementations. ``fused_mlp_forward`` grids
+the row axis to stay within the VMEM budget; ``fused_eval_confusion`` holds
+one client's rows at a time and refuses shapes whose activations would not
+fit (its confusion contraction needs the whole shard in one pass).
+
+Measured on the v5e (benchmarks/RESULTS.md 'Pallas kernel timings', round 4):
+XLA beats every kernel here at the income shapes — Mosaic's matmul codegen
+for pad-dominated operands (K=14 / N=2 against the 128-lane MXU) is several
+times slower than XLA's, the same effect that sank the whole-round
+mega-kernel attempt (benchmarks/mega_kernel_attempt.py). The kernels remain
+as tested library ops and educational artifacts; every production path keeps
+XLA by measurement, not by default.
 """
 
 from __future__ import annotations
@@ -116,6 +126,99 @@ def fused_mlp_forward(params, x: jax.Array,
         interpret=interpret,
     )(x.astype(jnp.float32), *weight_args)
     return out[:n_orig] if n != n_orig else out
+
+
+def _eval_conf_kernel(num_layers, num_classes, n_rows, x_ref, y_ref,
+                      *refs):
+    """Per-client fused eval: forward -> argmax -> masked confusion, all
+    VMEM-resident; only the (K, K) counts (padded to a tile) leave."""
+    out_ref = refs[-1]
+    c = pl.program_id(0)
+    h = x_ref[0]
+    for i in range(num_layers):
+        w = refs[2 * i][0]
+        b = refs[2 * i + 1][pl.ds(c, 1), :]
+        h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b
+        if i < num_layers - 1:
+            h = jnp.maximum(h, 0.0)
+    # First-max argmax via 2-D column scans (Mosaic rejects 1-D layouts
+    # with row offsets, so everything stays (N, 1)-shaped).
+    best = h[:, 0:1]
+    idx = jnp.zeros((n_rows, 1), jnp.float32)
+    for k in range(1, num_classes):
+        cur = h[:, k:k + 1]
+        idx = jnp.where(cur > best, jnp.float32(k), idx)
+        best = jnp.maximum(best, cur)
+    pred_oh = jnp.concatenate(
+        [(idx == jnp.float32(k)).astype(jnp.float32)
+         for k in range(num_classes)], axis=1)
+    oh = y_ref[0]                       # pre-masked one-hot labels (N, K)
+    conf = jax.lax.dot_general(oh, pred_oh, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32,
+                               precision=jax.lax.Precision.HIGHEST)
+    out_ref[0] = jnp.pad(conf, ((0, 8 - num_classes),
+                                (0, 128 - num_classes)))
+
+
+def fused_eval_confusion(params, x: jax.Array, y: jax.Array,
+                         mask: jax.Array, num_classes: int,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """Batched-over-clients fused eval: ``(C, K, K)`` confusion matrices
+    from client-stacked params ``{layers: [{w: (C,di,dj), b: (C,dj)}]}``
+    and data ``x (C,N,D), y (C,N), mask (C,N)`` in ONE kernel — the
+    in-VMEM analogue of ``vmap(local_eval)`` (fedtpu.training.client).
+    Bit-parity with the XLA chain is pinned in tests/test_pallas.py;
+    measured on the v5e it LOSES to the XLA chain by a wide margin
+    (benchmarks/RESULTS.md 'Pallas kernel timings': Mosaic's matmul
+    codegen at these pad-dominated shapes), so every production path
+    keeps XLA and this kernel stays a library/educational op.
+    ``num_classes`` must be <= 8 (the padded output tile's sublane
+    count)."""
+    if interpret is None:
+        interpret = _auto_interpret()
+    if num_classes > 8:
+        raise ValueError(f"num_classes={num_classes} > 8 unsupported "
+                         "(confusion tile padding)")
+    layers = params["layers"]
+    nl = len(layers)
+    c, n, d = x.shape
+    # No row tiling here — the confusion contraction consumes the whole
+    # shard in one pass — so the widest per-client activation must fit
+    # the VMEM budget; refuse loudly instead of failing in Mosaic.
+    widest = max([d, num_classes] + [l["w"].shape[-1] for l in layers])
+    if n * widest * 4 > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"fused_eval_confusion: {n} rows x {widest} widest dim "
+            f"exceeds the {_VMEM_BUDGET_BYTES >> 20} MB VMEM budget; "
+            "use the XLA eval path for shards this large")
+    # Mask folded into the labels' one-hot once, outside the kernel.
+    ohm = (jax.nn.one_hot(y, num_classes, dtype=jnp.float32)
+           * mask.astype(jnp.float32)[..., None])
+    in_specs = [
+        pl.BlockSpec((1, n, d), lambda c: (c, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, n, num_classes), lambda c: (c, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [x.astype(jnp.float32), ohm]
+    for l in layers:
+        w, b = l["w"], l["b"]
+        in_specs.append(pl.BlockSpec((1,) + w.shape[1:],
+                                     lambda c: (c, 0, 0),
+                                     memory_space=pltpu.VMEM))
+        in_specs.append(pl.BlockSpec(b.shape, lambda c: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        args.extend([w.astype(jnp.float32), b.astype(jnp.float32)])
+    out = pl.pallas_call(
+        functools.partial(_eval_conf_kernel, nl, num_classes, n),
+        out_shape=jax.ShapeDtypeStruct((c, 8, 128), jnp.float32),
+        grid=(c,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 8, 128), lambda c: (c, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(*args)
+    return out[:, :num_classes, :num_classes]
 
 
 def _wavg_kernel(x_ref, w_ref, out_ref):

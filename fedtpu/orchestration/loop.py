@@ -334,7 +334,9 @@ def build_experiment(cfg: ExperimentConfig,
 
     # Opt-in Pallas fused forward for the held-out eval (a plain jit, outside
     # shard_map; the in-round eval stays on the XLA path, which shard_map's
-    # scan requires in interpret mode).
+    # scan requires in interpret mode). Measured on the v5e the XLA path is
+    # FASTER (4.5 vs 6.1 us — benchmarks/RESULTS.md 'Pallas kernel
+    # timings'), so this stays opt-in for demonstration, not a perf default.
     eval_apply = apply_fn
     if (model_cfg.use_pallas and model_cfg.kind == "mlp"
             and model_cfg.param_dtype == "float32"

@@ -20,8 +20,9 @@ fast sender can never overwrite an unconsumed slot. The interpret-mode
 interpreter does not implement remote semaphore signals, so on CPU test
 meshes the kernel runs with the data schedule only (interpret mode
 serializes devices, which makes the sync redundant there); the sync path
-compiles for Mosaic but — single-chip image — has not run on multi-chip
-hardware.
+AOT-Mosaic-compiles for a real 4-chip v5e 2x2 topology
+(benchmarks/pallas_timing.py, via jax.experimental.topologies) but —
+single-chip image — has not EXECUTED on multi-chip hardware.
 
 Scope: a tested library collective, NOT a round-engine backend. Pallas
 kernels cannot run inside ``shard_map``'s ``lax.scan`` in interpret mode

@@ -65,3 +65,53 @@ def test_weighted_average_kernel_matches_numpy():
     out = weighted_average_clients(jnp.asarray(stacked), jnp.asarray(w),
                                    interpret=True)
     np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+
+def test_fused_eval_confusion_matches_xla_chain():
+    # The batched fused eval->confusion kernel (measured SLOWER than the
+    # XLA chain on the v5e — see RESULTS.md; kept as a library op) must
+    # match vmap(argmax -> confusion_matrix) exactly in interpret mode.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+    from fedtpu.data.sharding import pack_clients
+    from fedtpu.data.tabular import synthetic_income_like
+    from fedtpu.models import build_model
+    from fedtpu.ops import build_optimizer
+    from fedtpu.ops.metrics import confusion_matrix
+    from fedtpu.ops.pallas_kernels import fused_eval_confusion
+    from fedtpu.parallel import make_mesh
+    from fedtpu.parallel.round import init_federated_state
+
+    x, y = synthetic_income_like(64 * 4, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=4, shuffle=False))
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(16,)))
+    tx = build_optimizer(OptimConfig())
+    mesh = make_mesh(num_clients=4)
+    state = init_federated_state(jax.random.key(3), mesh, 4, init_fn, tx,
+                                 same_init=False)
+    xd, yd, md = (jnp.asarray(packed.x), jnp.asarray(packed.y),
+                  jnp.asarray(packed.mask))
+    conf_pal = fused_eval_confusion(state["params"], xd, yd, md, 2)
+    conf_xla = jax.vmap(lambda p, xx, yy, mm: confusion_matrix(
+        yy, jnp.argmax(apply_fn(p, xx), -1), mm, 2))(
+            state["params"], xd, yd, md)
+    np.testing.assert_array_equal(np.asarray(conf_pal),
+                                  np.asarray(conf_xla))
+
+
+def test_fused_eval_confusion_rejects_wide_class_counts():
+    import jax.numpy as jnp
+    import pytest
+
+    from fedtpu.ops.pallas_kernels import fused_eval_confusion
+
+    params = {"layers": [{"w": jnp.zeros((2, 4, 9)),
+                          "b": jnp.zeros((2, 9))}]}
+    with pytest.raises(ValueError, match="> 8"):
+        fused_eval_confusion(params, jnp.zeros((2, 8, 4)),
+                             jnp.zeros((2, 8), jnp.int32),
+                             jnp.ones((2, 8)), 9)
