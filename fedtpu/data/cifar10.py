@@ -39,16 +39,37 @@ def _load_batch(path: str) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def synthetic_cifar_like(rows: int, seed: int = 11,
-                         image_shape=(32, 32, 3), classes: int = 10):
-    """Class-conditioned Gaussian blobs in image space — deterministic,
-    learnable, CIFAR-shaped."""
+                         image_shape=(32, 32, 3), classes: int = 10,
+                         center_scale: float = 0.12,
+                         noise_std: float = 0.5,
+                         label_noise: float = 0.15):
+    """Class-conditioned Gaussian blobs + label noise — deterministic,
+    CIFAR-shaped, and NON-separable by construction (VERDICT r3 #5: the
+    round-3 generator's wide centers saturated the config-5 benchmark at
+    accuracy 1.0 by round 38, a smoke test wearing benchmark clothes).
+
+    ``center_scale`` sets the class overlap: pairwise center distance is
+    ~``center_scale * sqrt(2 * dim)`` against per-direction noise std
+    ``noise_std``. Defaults calibrated on the v5e (round 4): 0.04 left
+    the 300-round config-5 trajectory at 0.20 (too hard), 0.08 at 0.58,
+    0.12 plateaus at ~0.81 by round ~200 — learnable, sub-cap, and
+    falsifiable (the label-noise ceiling is ~0.865).
+    ``label_noise`` uniformly re-draws that fraction of labels
+    (including possibly the true one), capping reachable accuracy well
+    below 1.0 unless the model memorizes individual flipped points.
+    ``center_scale=1.0, label_noise=0.0`` reproduces the old separable
+    smoke-test distribution."""
     rng = np.random.default_rng(seed)
     y = np.arange(rows) % classes
     rng.shuffle(y)
     h, w, ch = image_shape
-    centers = rng.normal(0.0, 1.0, size=(classes, h, w, ch))
-    x = centers[y] + rng.normal(0.0, 0.5, size=(rows, h, w, ch))
-    return x.astype(np.float32), y.astype(np.int32)
+    centers = rng.normal(0.0, center_scale, size=(classes, h, w, ch))
+    x = centers[y] + rng.normal(0.0, noise_std, size=(rows, h, w, ch))
+    y_obs = y.copy()
+    if label_noise > 0:
+        flip = rng.random(rows) < label_noise
+        y_obs[flip] = rng.integers(0, classes, int(flip.sum()))
+    return x.astype(np.float32), y_obs.astype(np.int32)
 
 
 def load_cifar10(root: Optional[str] = None, flatten: bool = True,
