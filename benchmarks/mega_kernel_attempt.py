@@ -30,7 +30,7 @@ from fedtpu.ops import build_optimizer
 from fedtpu.parallel import make_mesh
 from fedtpu.parallel.round import build_round_fn, init_federated_state
 from fedtpu.utils.trees import clone
-from fedtpu.utils.timing import force_fetch
+from fedtpu.utils.timing import force_fetch, marginal_slope
 
 ds = load_tabular_dataset(DataConfig(csv_path=default_income_csv()))
 packed = pack_clients(ds.x_train, ds.y_train, ShardConfig(num_clients=8))
@@ -230,7 +230,7 @@ def make_scan(R):
         def body(carry, r):
             Ws, Bs, muW, nuW, muB, nuB = carry
             t = r
-            lr_t = LR0 * (GAMMA ** (t // STEPSZ)).astype(jnp.float32) if False else LR0 * jnp.power(GAMMA, (t // STEPSZ).astype(jnp.float32))
+            lr_t = LR0 * jnp.power(GAMMA, (t // STEPSZ).astype(jnp.float32))
             c1_t = 1 - jnp.power(B1, (t + 1).astype(jnp.float32))
             c2_t = 1 - jnp.power(B2, (t + 1).astype(jnp.float32))
             sc = jnp.stack([lr_t, c1_t, c2_t]).astype(jnp.float32)
@@ -254,15 +254,6 @@ acc_x = np.asarray(m_x2["per_client"]["accuracy"])[-1]
 print("acc after 100 rounds: fused", acc.mean(), "xla", acc_x.mean())
 assert abs(acc.mean() - acc_x.mean()) < 0.01, "trajectory diverged"
 
-def slope_time(mk, lens=(1000, 4000), reps=3):
-    ts = []
-    for R in lens:
-        fn = mk(R); force_fetch(fn())
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter(); force_fetch(fn()); best = min(best, time.perf_counter()-t0)
-        ts.append(best)
-    return (ts[1]-ts[0])/(lens[1]-lens[0])
 
 flat0 = unpack(clone(state0))
 def mk(R):
@@ -271,6 +262,6 @@ def mk(R):
         carry, losses, confs = f(flat0)
         return confs[-1].sum()
     return run
-m = slope_time(mk)
+m = marginal_slope(mk)
 flops = 736897920.0
 print(f"fused round marginal: {m*1e6:.2f} us/round -> {flops/m/1e12:.1f} TFLOP/s, {flops/m/158e12*100:.1f}% MFU vs measured peak")

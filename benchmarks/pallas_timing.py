@@ -33,24 +33,12 @@ from fedtpu.ops.pallas_kernels import (fused_eval_confusion,
                                        weighted_average_clients)
 from fedtpu.parallel import make_mesh
 from fedtpu.parallel.round import init_federated_state
-from fedtpu.utils.timing import force_fetch
+from fedtpu.utils.timing import force_fetch, marginal_slope
 from fedtpu.utils.trees import clone
 
 NUM_CLIENTS = 8
 
 
-def slope_time(gen, lens=(1000, 4000), reps=4):
-    ts = []
-    for R in lens:
-        fn = gen(R)
-        force_fetch(fn())
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            force_fetch(fn())
-            best = min(best, time.perf_counter() - t0)
-        ts.append(best)
-    return (ts[1] - ts[0]) / (lens[1] - lens[0])
 
 
 def scan_over(fn_body, const):
@@ -87,9 +75,9 @@ def main():
     out = {}
 
     # ---- 1. fused_mlp_forward vs XLA apply (the held-out eval shape)
-    m_pal = slope_time(scan_over(
+    m_pal = marginal_slope(scan_over(
         lambda p: fused_mlp_forward(p, x_test), p0))
-    m_xla = slope_time(scan_over(
+    m_xla = marginal_slope(scan_over(
         lambda p: apply_fn(p, x_test), p0))
     out["heldout_eval_forward"] = {"pallas_s": m_pal, "xla_s": m_xla}
 
@@ -103,19 +91,19 @@ def main():
     def xla_wavg(f):
         return (w @ f) / w.sum()
 
-    m_pal_w = slope_time(scan_over(
+    m_pal_w = marginal_slope(scan_over(
         lambda f: weighted_average_clients(f, w), flat))
-    m_xla_w = slope_time(scan_over(xla_wavg, flat))
+    m_xla_w = marginal_slope(scan_over(xla_wavg, flat))
     out["weighted_average"] = {"pallas_s": m_pal_w, "xla_s": m_xla_w,
                                "flat_dim": int(flat.shape[1])}
 
     # ---- 3. fused eval->confusion vs the XLA eval chain (in-round shape)
-    m_pal_e = slope_time(scan_over(
+    m_pal_e = marginal_slope(scan_over(
         lambda p: fused_eval_confusion(p, xd, yd, md, ds.num_classes),
         params))
     # The XLA chain is fast enough (~2-5 us/iter) that the default
     # windows sink under dispatch jitter; widen them.
-    m_xla_e = slope_time(scan_over(
+    m_xla_e = marginal_slope(scan_over(
         lambda p: jax.vmap(lambda pp, xx, yy, mm: confusion_matrix(
             yy, jnp.argmax(apply_fn(pp, xx), -1), mm,
             ds.num_classes))(p, xd, yd, md), params),
@@ -143,8 +131,12 @@ def main():
     sharded = jax.ShapeDtypeStruct(
         (4, 1024), jnp.float32,
         sharding=NamedSharding(ring_mesh, P("clients")))
-    compiled = jax.jit(ring_fn).lower(sharded).compile()
-    out["ring_sync_aot_v5e_2x2"] = compiled.cost_analysis() is not None
+    try:
+        jax.jit(ring_fn).lower(sharded).compile()
+        out["ring_sync_aot_v5e_2x2"] = True
+    except Exception as e:
+        out["ring_sync_aot_v5e_2x2"] = False
+        out["ring_sync_aot_error"] = f"{type(e).__name__}: {e}"[:500]
 
     print(json.dumps(out, indent=2, default=float))
     for name, row in out.items():

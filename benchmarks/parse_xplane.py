@@ -8,13 +8,14 @@ the activation-stream fusions that dominate the income round.
 """
 import sys, collections
 from tensorflow.tsl.profiler.protobuf import xplane_pb2
-xs = xplane_pb2.XSpace()
-xs.ParseFromString(open(sys.argv[1], "rb").read())
-for plane in xs.planes:
+for path in sys.argv[1:]:
+  print(f"=== file: {path}")
+  xs = xplane_pb2.XSpace()
+  xs.ParseFromString(open(path, "rb").read())
+  for plane in xs.planes:
     print("== plane:", plane.name)
     if "TPU" not in plane.name and "device" not in plane.name.lower():
         continue
-    stats_meta = {i: m.name for i, m in plane.stat_metadata.items()}
     ev_meta = {i: m.name for i, m in plane.event_metadata.items()}
     agg = collections.Counter()
     cnt = collections.Counter()

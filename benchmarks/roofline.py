@@ -48,28 +48,12 @@ from fedtpu.parallel import client_sharding, make_mesh
 from fedtpu.parallel.round import build_round_fn, init_federated_state
 from fedtpu.training.client import make_local_train_step
 from fedtpu.utils.timing import (compile_with_flops, force_fetch,
-                                 measured_peak_flops)
+                                 marginal_slope, measured_peak_flops)
 from fedtpu.utils.trees import clone
 
 NUM_CLIENTS = 8
 
 
-def slope_time(gen, lens=(1000, 4000), reps=4):
-    """Marginal seconds-per-round via the scan-length slope: fixed
-    dispatch/fetch costs cancel between the two window lengths. Each
-    window is fetch-forced (the only completion proof on this
-    transport)."""
-    ts = []
-    for R in lens:
-        fn = gen(R)
-        force_fetch(fn())                       # compile + warm
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            force_fetch(fn())
-            best = min(best, time.perf_counter() - t0)
-        ts.append(best)
-    return (ts[1] - ts[0]) / (lens[1] - lens[0])
 
 
 def income_setup():
@@ -153,9 +137,9 @@ def main():
 
     # Stage slopes carry ~1-2 us of window jitter each (the differences
     # below inherit it doubled); more reps narrow the min-window noise.
-    m_full = slope_time(full, reps=6)
-    m_train = slope_time(train_only, reps=6)
-    m_agg = slope_time(train_agg, reps=6)
+    m_full = marginal_slope(full, reps=6)
+    m_train = marginal_slope(train_only, reps=6)
+    m_agg = marginal_slope(train_agg, reps=6)
     out["marginal_s"] = {"full_round": m_full, "train_only": m_train,
                          "train_plus_agg": m_agg,
                          "eval_metrics": m_full - m_agg,
@@ -179,7 +163,7 @@ def main():
                 c, ss = jax.lax.scan(body, x0, length=R)
                 return ss[-1]
             return lambda: f(x)
-        m = slope_time(gen)
+        m = marginal_slope(gen)
         nbytes = 2 * x.dtype.itemsize * x.size
         ceilings[name] = {"s_per_pass": m, "tb_per_s": nbytes / m / 1e12}
     out["stream_ceiling_8x1000x200"] = ceilings
@@ -210,7 +194,7 @@ def main():
         s1 = build_round_fn(mesh, apply2, tx, ds2.num_classes,
                             rounds_per_step=1)
         _, fl2 = compile_with_flops(s1, clone(state2), batch2)
-        m2 = slope_time(gen, lens)
+        m2 = marginal_slope(gen, lens)
         shapes.append({"rows_per_client": int(packed2.x.shape[1]),
                        "hidden": list(hidden), "marginal_s": m2,
                        "flops": fl2, "mfu": fl2 / (m2 * peak)})

@@ -1,0 +1,165 @@
+"""End-to-end sweep wall clock: fedtpu's 90-config grid vs the measured
+reference-equivalent sklearn sweep (VERDICT r3 #2).
+
+fedtpu side — ``run_grid_search`` on the income data, three ways:
+fixed-400 bucketed (production default), fixed-400 unbucketed (the
+round-3 one-compile-per-architecture path), plateau-stop bucketed (the
+sklearn-faithful semantics). Wall clock includes EVERY compile; a
+second bucketed run in the same process shows the warm-cache time.
+Completion is fetch-forced implicitly: run_grid_search materializes
+every metric to numpy before returning.
+
+Reference side — a faithful single-host simulation of
+``hyperparameters_tuning.py:80-132`` under ``mpirun -np 8``: per config
+every rank fits a fresh ``MLPClassifier(hidden, learning_rate_init=lr,
+max_iter=400, random_state=42)`` on its shard (sklearn's own tol-1e-4 /
+10-epoch plateau stopping active, exactly what the reference runs),
+local predictions BEFORE averaging, rank-0 uniform weight average, and
+pooled metrics from the concatenated predictions. Ranks run
+concurrently under mpirun, so fit+predict time is credited
+/min(8, cpu_count) (ideal oversubscription; 1 on this box — the
+speedup shrinks accordingly on real 8-core hosts and both numbers are
+in the artifact); the averaging + metrics path stays serial.
+
+Run: ``python benchmarks/sweep_bench.py [--skip-sklearn]`` (~15 min:
+~2 min fedtpu + ~10 min sklearn baseline on the 1-core box).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import warnings
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+from fedtpu.config import DataConfig, ExperimentConfig, ShardConfig, \
+    default_income_csv
+from fedtpu.data import load_dataset
+from fedtpu.sweep.grid import HIDDEN_GRID, LR_GRID, run_grid_search
+
+NUM_CLIENTS = 8
+
+
+def bench_fedtpu(cfg, ds):
+    out = {}
+    for label, kw in (
+            ("fixed400_bucketed", dict(bucket_pad=True)),
+            ("fixed400_unbucketed", dict(bucket_pad=False)),
+            ("plateau_bucketed", dict(bucket_pad=True, plateau_stop=True)),
+    ):
+        t0 = time.perf_counter()
+        best = run_grid_search(cfg, dataset=ds, verbose=False, **kw)
+        dt = time.perf_counter() - t0
+        out[label] = {"wall_s": dt, "compile_count": best["compile_count"],
+                      "best": best["params"],
+                      "best_accuracy": best["accuracy"],
+                      "configs": len(best["table"])}
+        print(f"[sweep] fedtpu {label}: {dt:.1f} s, "
+              f"{best['compile_count']} compiles, winner {best['params']} "
+              f"acc {best['accuracy']:.4f}", flush=True)
+    # Warm-cache rerun of the production mode: the steady-state sweep time
+    # once the jit cache holds the two depth-class programs.
+    t0 = time.perf_counter()
+    best = run_grid_search(cfg, dataset=ds, verbose=False, bucket_pad=True)
+    out["fixed400_bucketed_warm"] = {"wall_s": time.perf_counter() - t0,
+                                     "best": best["params"]}
+    print(f"[sweep] fedtpu fixed400_bucketed warm rerun: "
+          f"{out['fixed400_bucketed_warm']['wall_s']:.1f} s", flush=True)
+    return out
+
+
+def bench_sklearn(ds):
+    from sklearn.neural_network import MLPClassifier
+    from sklearn.metrics import (accuracy_score, precision_score,
+                                 recall_score, f1_score)
+
+    n = len(ds.x_train)
+    chunk = n // NUM_CLIENTS
+    shards = []
+    for r in range(NUM_CLIENTS):
+        s, e = r * chunk, (r + 1) * chunk if r != NUM_CLIENTS - 1 else n
+        shards.append((ds.x_train[s:e], ds.y_train[s:e]))
+
+    parallel = min(NUM_CLIENTS, os.cpu_count() or 1)
+    t_fit = 0.0          # concurrent under mpirun: credited /parallel
+    t_serial = 0.0       # rank-0 averaging + pooled metrics: serial
+    best_acc, best_cfg = -1.0, None
+    for hidden in HIDDEN_GRID:
+        for lr in LR_GRID:
+            coefs, inters, preds_all, y_all = [], [], [], []
+            for x_s, y_s in shards:
+                t0 = time.perf_counter()
+                clf = MLPClassifier(hidden_layer_sizes=hidden,
+                                    learning_rate_init=lr, max_iter=400,
+                                    random_state=42)
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    clf.fit(x_s, y_s)
+                preds = clf.predict(x_s)
+                t_fit += time.perf_counter() - t0
+                coefs.append(clf.coefs_)
+                inters.append(clf.intercepts_)
+                preds_all.append(preds)
+                y_all.append(y_s)
+            t0 = time.perf_counter()
+            # rank-0 uniform average (hyperparameters_tuning.py:24-46).
+            avg_c = [np.mean([c[i] for c in coefs], axis=0)
+                     for i in range(len(coefs[0]))]
+            avg_i = [np.mean([c[i] for c in inters], axis=0)
+                     for i in range(len(inters[0]))]
+            del avg_c, avg_i
+            yp = np.concatenate(preds_all)
+            yt = np.concatenate(y_all)
+            acc = accuracy_score(yt, yp)
+            precision_score(yt, yp, average="weighted", zero_division=0)
+            recall_score(yt, yp, average="weighted", zero_division=0)
+            f1_score(yt, yp, average="weighted", zero_division=0)
+            t_serial += time.perf_counter() - t0
+            if acc > best_acc:
+                best_acc, best_cfg = acc, (tuple(hidden), lr)
+        print(f"[sweep] sklearn arch {hidden} done "
+              f"(fit so far {t_fit:.0f} s)", flush=True)
+    return {"fit_s": t_fit, "serial_s": t_serial,
+            "assumed_parallelism": parallel,
+            "wall_s": t_fit / parallel + t_serial,
+            "wall_s_if_8cores": t_fit / NUM_CLIENTS + t_serial,
+            "best": {"hidden_layer_sizes": best_cfg[0],
+                     "learning_rate": best_cfg[1]},
+            "best_accuracy": best_acc}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-sklearn", action="store_true")
+    args = ap.parse_args()
+    cfg = ExperimentConfig(
+        data=DataConfig(csv_path=default_income_csv(),
+                        label_column="income"),
+        shard=ShardConfig(num_clients=NUM_CLIENTS))
+    ds = load_dataset(cfg.data)
+    result = {"fedtpu": bench_fedtpu(cfg, ds)}
+    if not args.skip_sklearn:
+        result["sklearn_reference"] = bench_sklearn(ds)
+        ours = result["fedtpu"]["plateau_bucketed"]["wall_s"]
+        ref = result["sklearn_reference"]["wall_s"]
+        result["speedup_plateau_vs_reference"] = ref / ours
+        result["speedup_if_8core_host"] = (
+            result["sklearn_reference"]["wall_s_if_8cores"] / ours)
+        print(f"[sweep] sklearn reference sweep: {ref:.1f} s "
+              f"(fit {result['sklearn_reference']['fit_s']:.1f} s / "
+              f"parallel {result['sklearn_reference']['assumed_parallelism']}"
+              f" + serial {result['sklearn_reference']['serial_s']:.1f} s)"
+              f" -> fedtpu plateau sweep {ours:.1f} s = "
+              f"{ref / ours:.1f}x (8-core counterfactual "
+              f"{result['speedup_if_8core_host']:.1f}x)", flush=True)
+    print(json.dumps(result, default=float))
+
+
+if __name__ == "__main__":
+    main()

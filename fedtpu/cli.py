@@ -308,6 +308,13 @@ def build_parser() -> argparse.ArgumentParser:
                               "weights + hyperparameters + metrics as an "
                               ".npz (the reference only prints them, "
                               "hyperparameters_tuning.py:130-132)")
+    sweep_p.add_argument("--no-bucket-pad", action="store_true",
+                         help="compile one program per architecture "
+                              "instead of zero-padding each to its depth "
+                              "class's max dims (the pad is exact math; "
+                              "bucketing cuts the 90-config grid from 10 "
+                              "compiles to 2 — benchmarks/RESULTS.md "
+                              "'Sweep wall clock')")
     sweep_p.add_argument("--plateau-stop", action="store_true",
                          help="sklearn-faithful local fits: treat the step "
                               "budget as a cap and stop each (client, lr) "
@@ -388,6 +395,7 @@ def main(argv=None) -> int:
                 **grid_kw,
                 keep_weights=bool(args.save_weights),
                 plateau_stop=args.plateau_stop,
+                bucket_pad=not args.no_bucket_pad,
                 verbose=not args.quiet)
             if table_f is not None:
                 for row in summary["table"]:

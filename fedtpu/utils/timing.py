@@ -223,3 +223,26 @@ def assert_above_flops_floor(sec_per_round: float, flops_per_round: float,
             f"{floor:.3e} s/round. The timed window is not capturing "
             "execution (dispatch-rate artifact); close it with force_fetch.")
     return floor
+
+
+def marginal_slope(make_fn, lens=(1000, 4000), reps=4):
+    """Marginal seconds-per-iteration via the scan-length SLOPE:
+    ``(t(lens[1]) - t(lens[0])) / (lens[1] - lens[0])``, each window
+    fetch-forced and min-of-``reps``. Fixed per-call costs — dispatch RTT
+    through the tunnel and the completion fetch — cancel exactly, so the
+    result is the pure on-device marginal (the same methodology as
+    ``measured_peak_flops``; shared by the round-4 roofline and Pallas
+    benchmarks so the scripts cannot drift apart). ``make_fn(R)`` must
+    return a zero-arg callable running an R-iteration program whose
+    result force_fetch can prove complete."""
+    ts = []
+    for R in lens:
+        fn = make_fn(R)
+        force_fetch(fn())                  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            force_fetch(fn())
+            best = min(best, time.perf_counter() - t0)
+        ts.append(best)
+    return (ts[1] - ts[0]) / (lens[1] - lens[0])

@@ -283,3 +283,54 @@ def test_plateau_stop_fires_before_the_cap_like_sklearn():
                                 lr_grid=(0.004,), local_steps=400,
                                 verbose=False)
     assert res_fixed["table"][0]["mean_local_steps"] == 400
+
+
+def test_bucket_pad_matches_unpadded_exactly():
+    """Zero-padding to the depth bucket is EXACT for a ReLU MLP (module
+    docstring): padded activations stay zero through forward, ReLU'(0)=0
+    kills their gradients, Adam leaves zero weights zero. The whole
+    table, the winner, and the winner's (sliced) weights must match the
+    unpadded run; compile count must drop to one per depth class."""
+    cfg = _cfg()
+    ds = load_tabular_dataset(cfg.data)
+    hidden = ((8,), (16,), (4, 4), (16, 8), (8, 16))   # 2 depth classes
+    lrs = (0.01, 0.05)
+    kw = dict(dataset=ds, hidden_grid=hidden, lr_grid=lrs, local_steps=20,
+              keep_weights=True, verbose=False)
+    res_b = run_grid_search(cfg, bucket_pad=True, **kw)
+    res_u = run_grid_search(cfg, bucket_pad=False, **kw)
+
+    tb = {(r["hidden_layer_sizes"], r["learning_rate"]): r
+          for r in res_b["table"]}
+    tu = {(r["hidden_layer_sizes"], r["learning_rate"]): r
+          for r in res_u["table"]}
+    assert set(tb) == set(tu) and len(tb) == 10
+    for k in tb:
+        for m in ("accuracy", "precision", "recall", "f1"):
+            np.testing.assert_allclose(tb[k][m], tu[k][m], atol=1e-6)
+    assert res_b["params"] == res_u["params"]
+    # Winner weights come back at TRUE dims and match the unpadded run.
+    for lb, lu in zip(res_b["weights"]["layers"],
+                      res_u["weights"]["layers"]):
+        assert lb["w"].shape == lu["w"].shape
+        np.testing.assert_allclose(lb["w"], lu["w"], atol=1e-6)
+    # 5 architectures, 2 depth classes: bucketing compiles 2 programs.
+    if res_b["compile_count"] is not None:
+        assert res_b["compile_count"] == 2
+        assert res_u["compile_count"] == 5
+
+
+def test_bucket_pad_plateau_matches_unpadded():
+    # The plateau detector watches a loss that includes the L2 term —
+    # zero pads add exactly zero to it, so stop points cannot move.
+    cfg = _cfg()
+    ds = load_tabular_dataset(cfg.data)
+    kw = dict(dataset=ds, hidden_grid=((4, 4), (8, 16)), lr_grid=(0.05,),
+              local_steps=60, plateau_stop=True, verbose=False)
+    res_b = run_grid_search(cfg, bucket_pad=True, **kw)
+    res_u = run_grid_search(cfg, bucket_pad=False, **kw)
+    for rb, ru in zip(res_b["table"], res_u["table"]):
+        np.testing.assert_allclose(rb["mean_local_steps"],
+                                   ru["mean_local_steps"], atol=0)
+        np.testing.assert_allclose(rb["accuracy"], ru["accuracy"],
+                                   atol=1e-6)
