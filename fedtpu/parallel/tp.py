@@ -140,22 +140,50 @@ def init_federated_state_2d(key: jax.Array, mesh: Mesh, num_clients: int,
                             same_init: bool = False,
                             server_opt: ServerOptimizer | None = None
                             ) -> dict:
-    """Global-view per-client state laid out on the 2-D mesh. Optimizer
-    moments inherit the param shardings via jit sharding propagation.
+    """Global-view per-client state laid out on the 2-D mesh, with every
+    buffer BORN on its declared sharding: init runs inside one jit whose
+    ``out_shardings`` carry the 2-D layout, so no device ever holds a full
+    replica — required at exactly the scale this engine exists for (a
+    model whose whole params+moments exceed one chip's HBM could not
+    survive an unsharded init, and GSPMD propagation alone is not a
+    guarantee either: at small shapes it replicates the Adam moments over
+    'model', tripling per-device state —
+    tests/test_tp.py::test_per_device_state_bytes_scale_down_with_tp).
 
     ``server_opt`` mirrors the 1-D engine (fedtpu.parallel.round): the
     server model is the uniform mean of the client inits, every client
     starts FROM it, and ``server_opt_state`` (clients-free pytrees) lays
     out with the client axis dropped — model-sharded like the params."""
-    params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
-    specs = tp_specs(params)
-    if server_opt is not None:
-        g0 = jax.tree.map(lambda p: p.mean(axis=0), params)
-        params = jax.tree.map(
-            lambda g, p: jnp.broadcast_to(g[None], p.shape), g0, params)
-    params = jax.tree.map(
-        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
-    opt_state = jax.jit(jax.vmap(tx.init))(params)
+    keys = client_init_keys(key, num_clients, same_init)
+    pshape = jax.eval_shape(jax.vmap(init_fn), keys)
+    specs = tp_specs(pshape)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                          is_leaf=lambda x: isinstance(x, P))
+    # Optax state subtrees that mirror the params treedef (Adam mu/nu) get
+    # the param shardings; everything else (step counts) replicates.
+    ptree = jax.tree.structure(pshape)
+    oshape = jax.eval_shape(jax.vmap(tx.init), pshape)
+
+    def place_opt(sub):
+        if jax.tree.structure(sub) == ptree:
+            return pshard
+        return jax.tree.map(lambda _: NamedSharding(mesh, P()), sub)
+
+    oshard = jax.tree.map(
+        place_opt, oshape,
+        is_leaf=lambda x: x is not oshape
+        and jax.tree.structure(x) == ptree)
+
+    @partial(jax.jit, out_shardings=(pshard, oshard))
+    def _sharded_init(ks):
+        params = jax.vmap(init_fn)(ks)
+        if server_opt is not None:
+            g0 = jax.tree.map(lambda p: p.mean(axis=0), params)
+            params = jax.tree.map(
+                lambda g, p: jnp.broadcast_to(g[None], p.shape), g0, params)
+        return params, jax.vmap(tx.init)(params)
+
+    params, opt_state = _sharded_init(keys)
     state = {"params": params, "opt_state": opt_state,
              "round": jnp.zeros((), jnp.int32)}
     if server_opt is not None:

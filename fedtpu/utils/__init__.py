@@ -1,2 +1,3 @@
-from fedtpu.utils.trees import param_count, tree_bytes  # noqa: F401
+from fedtpu.utils.trees import (max_device_bytes, param_count,  # noqa: F401
+                                per_device_bytes, tree_bytes)
 from fedtpu.utils.timing import Timer  # noqa: F401

@@ -40,3 +40,20 @@ def clone(tree):
     """
     return jax.tree.map(
         lambda l: l.copy() if isinstance(l, jax.Array) else l, tree)
+
+
+def per_device_bytes(tree) -> dict:
+    """Measured live bytes per device id: sums each leaf's ACTUAL shard
+    buffers (``addressable_shards``), so replicated leaves count fully on
+    every device they occupy. The measurement behind the 2-D engine's
+    memory proof (benchmarks/tp_memory.py and its pinning test)."""
+    per: dict = {}
+    for leaf in jax.tree.leaves(tree):
+        for s in leaf.addressable_shards:
+            per[s.device.id] = per.get(s.device.id, 0) + s.data.nbytes
+    return per
+
+
+def max_device_bytes(tree) -> int:
+    """Max over devices of measured live bytes for ``tree``."""
+    return max(per_device_bytes(tree).values())

@@ -139,6 +139,7 @@ QUICK_TESTS = {
     "test_timing.py::test_timer_laps",
     "test_tp.py::test_mesh_2d_shape",
     "test_tp.py::test_unsupported_combos_raise",
+    "test_tp.py::test_per_device_state_bytes_scale_down_with_tp",
     # test_multihost_e2e spawns 2 OS processes (~70 s for the round-kernel
     # worker since the int8/Byzantine sections joined) and stays full-tier
     # only; fedtpu/parallel/multihost.py is covered above in-process.

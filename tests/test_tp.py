@@ -222,3 +222,28 @@ def test_run_experiment_model_parallel():
         verbose=False)
     np.testing.assert_allclose(res.global_metrics["accuracy"],
                                base.global_metrics["accuracy"], atol=1e-6)
+
+
+def test_per_device_state_bytes_scale_down_with_tp():
+    """The 2-D engine's reason to exist (benchmarks/tp_memory.py pins the
+    full-size numbers): measured per-device params+opt bytes drop ~1/tp
+    for a fixed federation as chips-per-client grow. Slack below the
+    ideal 2x/4x is the model-replicated logits head and row biases."""
+    from fedtpu.utils.trees import max_device_bytes
+
+    init_fn, _ = build_model(ModelConfig(input_dim=64,
+                                         hidden_sizes=(256, 256)))
+    tx = build_optimizer(OptimConfig())
+
+    def state_bytes(state):
+        return max_device_bytes({"p": state["params"],
+                                 "o": state["opt_state"]})
+
+    mesh1 = make_mesh(num_devices=2, num_clients=2)
+    base = state_bytes(
+        init_federated_state(jax.random.key(0), mesh1, 2, init_fn, tx))
+    for mp, floor in ((2, 1.8), (4, 3.6)):
+        mesh2 = tp.make_mesh_2d(mp, 2)
+        b = state_bytes(tp.init_federated_state_2d(
+            jax.random.key(0), mesh2, 2, init_fn, tx))
+        assert base / b > floor, (mp, base, b)
