@@ -77,6 +77,10 @@ def _add_common_overrides(p: argparse.ArgumentParser):
     p.add_argument("--prox-mu", type=_nonnegative_float, default=None,
                    help="FedProx proximal coefficient >= 0 (0 = plain "
                         "FedAvg; meaningful with --local-steps > 1)")
+    p.add_argument("--scaffold", action="store_true", default=None,
+                   help="SCAFFOLD control-variate drift correction "
+                        "(Karimireddy et al. 2020; needs --weighting "
+                        "uniform, full participation)")
     p.add_argument("--participation-rate", type=_participation_rate,
                    default=None,
                    help="per-round client sampling probability in (0, 1] "
@@ -189,6 +193,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         fed = dataclasses.replace(fed, local_steps=args.local_steps)
     if args.prox_mu is not None:
         fed = dataclasses.replace(fed, prox_mu=args.prox_mu)
+    if args.scaffold:
+        fed = dataclasses.replace(fed, scaffold=True)
     if args.participation_rate is not None:
         fed = dataclasses.replace(fed,
                                   participation_rate=args.participation_rate)

@@ -150,6 +150,16 @@ class FedConfig:
     # each local loss. Zero gradient at the anchor, so meaningful only with
     # local_steps > 1 (bounds client drift on non-IID shards). 0 = FedAvg.
     prox_mu: float = 0.0
+    # SCAFFOLD (Karimireddy et al. 2020): per-client control variates c_i
+    # and their server mean c correct every local gradient by (c - c_i),
+    # CANCELLING client drift instead of damping it like prox_mu — the
+    # stronger fix for many local steps on non-IID shards. Variate refresh
+    # is option I (gradient at the round-start global), exact under any
+    # local optimizer. Requires weighting='uniform', full participation,
+    # aggregation='psum', the 1-D engine; composes with local_steps,
+    # prox_mu, and the FedOpt server optimizers; not with DP (the variates
+    # would be an unaccounted release), compress, or robust rules.
+    scaffold: bool = False
     # Server-side optimizer over the weighted mean of client DELTAS (FedOpt
     # family, fedtpu.ops.server_opt): 'none' (parameter averaging — the
     # reference's rule) | 'fedavgm' | 'fedadagrad' | 'fedyogi' | 'fedadam'.

@@ -225,9 +225,10 @@ def build_experiment(cfg: ExperimentConfig,
             cfg.fed.server_opt, learning_rate=cfg.fed.server_lr,
             momentum=cfg.fed.server_momentum, b1=cfg.fed.server_b1,
             b2=cfg.fed.server_b2, tau=cfg.fed.server_tau)
-    elif cfg.fed.dp_clip_norm > 0:
-        # DP with plain averaging still runs the delta path and needs
-        # the (empty-momentum) server state initialized.
+    elif cfg.fed.dp_clip_norm > 0 or cfg.fed.scaffold:
+        # DP with plain averaging — and SCAFFOLD, whose server update is
+        # the paper's eta_g=1 — still run the delta path and need the
+        # (empty-momentum) server state initialized.
         from fedtpu.ops.server_opt import identity_server_optimizer
         server = identity_server_optimizer()
 
@@ -251,6 +252,9 @@ def build_experiment(cfg: ExperimentConfig,
                 or cfg.fed.byzantine_clients > 0):
             raise ValueError("robust aggregation / byzantine injection "
                              "requires the 1-D engine (model_parallel=1)")
+        if cfg.fed.scaffold:
+            raise ValueError("scaffold requires the 1-D engine "
+                             "(model_parallel=1)")
         # Only dims the tp specs actually place on the 'model' axis need to
         # divide: the col-sharded out-dims (even indices — row layers shard
         # the PREVIOUS layer's out-dim, already covered) plus, for convnets,
@@ -285,7 +289,8 @@ def build_experiment(cfg: ExperimentConfig,
         state_fn = lambda: init_federated_state(
             jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
             init_fn, tx, same_init=cfg.fed.same_init, server_opt=server,
-            shared_start=cfg.fed.compress != "none")
+            shared_start=cfg.fed.compress != "none",
+            scaffold=cfg.fed.scaffold)
         step_fn = lambda r: build_round_fn(
             mesh, apply_fn, tx, ds.num_classes, weighting=cfg.fed.weighting,
             rounds_per_step=r,
@@ -302,7 +307,8 @@ def build_experiment(cfg: ExperimentConfig,
             robust_aggregation=cfg.fed.robust_aggregation,
             trim_ratio=cfg.fed.trim_ratio,
             krum_f=cfg.fed.krum_f,
-            byzantine_clients=cfg.fed.byzantine_clients)
+            byzantine_clients=cfg.fed.byzantine_clients,
+            scaffold=cfg.fed.scaffold)
 
     batch = {
         "x": jax.device_put(packed.x, shard),
@@ -492,10 +498,18 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 state["round"] = jnp.asarray(raw_round, jnp.int32)
                 restored_history, start_round = raw_history, raw_round
                 if verbose:
+                    # Per-client SCAFFOLD variates are client-count-shaped
+                    # like the Adam moments: an elastic resume restarts
+                    # them at zero (invariant-consistent; the correction
+                    # re-warms over the next rounds) — say so, or a drift
+                    # study across a resume sees an unexplained regression.
+                    cv_note = (", control variates reset to zero"
+                               if "client_cv" in state else "")
                     print(f"Elastic resume at round {raw_round}: "
                           f"{saved_num_clients(raw)} -> "
                           f"{cfg.shard.num_clients} clients (global model "
-                          "carried over, fresh client optimizer state).",
+                          "carried over, fresh client optimizer state"
+                          f"{cv_note}).",
                           flush=True)
 
     # DP RDP bookkeeping lives in its own module (fedtpu.orchestration.
@@ -527,7 +541,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         binding (one definition — the two gates can't drift apart)."""
         return not bool(_tree_finite(
             {k: state[k] for k in
-             ("params", "opt_state", "server_opt_state") if k in state}))
+             ("params", "opt_state", "server_opt_state",
+              "client_cv", "server_cv") if k in state}))
 
     def halt_diverged(reason: str, label_round: int):
         """Shared divergence halt: quarantine the poisoned state under

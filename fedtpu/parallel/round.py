@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
+from fedtpu.ops.losses import masked_cross_entropy
 from fedtpu.ops.metrics import confusion_matrix, metrics_from_confusion
 from fedtpu.ops.server_opt import (ServerOptimizer, clip_by_global_norm,
                                    gaussian_noise_tree,
@@ -86,7 +87,8 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
                          init_fn: Callable, tx: optax.GradientTransformation,
                          same_init: bool = False,
                          server_opt: ServerOptimizer | None = None,
-                         shared_start: bool = False):
+                         shared_start: bool = False,
+                         scaffold: bool = False):
     """Per-client params + optimizer state, leading axis = clients, sharded.
 
     ``same_init=False`` matches the reference, where every rank constructs an
@@ -104,6 +106,11 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
     client from the uniform mean of the inits — required by aggregations
     that reconstruct the new global as ``start + mean(delta)`` (the int8
     compressed exchange, fedtpu.parallel.compress).
+
+    ``scaffold`` adds zero-initialized SCAFFOLD control variates:
+    ``client_cv`` (per-client, sharded like params) and ``server_cv``
+    (their replicated mean). Requires ``server_opt`` (the delta path) —
+    see ``build_round_fn(scaffold=True)``.
     """
     params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
     opt_state = jax.vmap(tx.init)(params)
@@ -125,9 +132,31 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
         if server_opt is not None:
             from jax.sharding import NamedSharding
             replicated = NamedSharding(mesh, P())
+            # Server accumulators live in f32 regardless of param dtype:
+            # the delta reduction is f32, so a bf16-born server state would
+            # change dtype across the scan carry (and bf16 momentum loses
+            # precision for no memory win at server scale).
             state["server_opt_state"] = jax.tree.map(
-                lambda t: jax.device_put(t, replicated),
+                lambda t: jax.device_put(t.astype(jnp.float32), replicated),
                 server_opt.init(g0))
+    if scaffold:
+        if server_opt is None:
+            raise ValueError(
+                "scaffold runs on the delta path — pass a server_opt "
+                "(identity_server_optimizer() for the paper's plain "
+                "eta_g=1 server update)")
+        from jax.sharding import NamedSharding
+        # Zero-initialized control variates (the paper's init): per-client
+        # c_i sharded like params, their replicated mean c. The invariant
+        # server_cv == mean(client_cv) holds from here inductively. Param
+        # dtype throughout — a f32 variate under bf16 params would promote
+        # the corrected grads and break the scan carry's dtype contract.
+        state["client_cv"] = jax.tree.map(
+            lambda p: put(jnp.zeros(p.shape, p.dtype)), params)
+        state["server_cv"] = jax.tree.map(
+            lambda g: jax.device_put(jnp.zeros(g.shape, g.dtype),
+                                     NamedSharding(mesh, P())),
+            jax.tree.map(lambda p: p[0], params))
     return state
 
 
@@ -147,7 +176,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                    robust_aggregation: str = "none",
                    trim_ratio: float = 0.1,
                    krum_f: int = 0,
-                   byzantine_clients: int = 0):
+                   byzantine_clients: int = 0,
+                   scaffold: bool = False):
     """Compile the full federated round. Returns
     ``round_step(state, batch) -> (state, metrics)`` where ``batch`` is a dict
     of client-sharded arrays ``x (C,N,...), y (C,N), mask (C,N)`` and
@@ -214,10 +244,26 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     sign-flipped update (a strong model-poisoning attack) while their local
     metrics stay honest — the knob that lets tests and chaos runs prove the
     robust rules hold and the plain mean breaks.
+
+    ``scaffold=True`` — SCAFFOLD (Karimireddy et al. 2020): each client
+    carries a control variate ``c_i`` (an estimate of its own shard's
+    gradient at the global model) and the server carries their mean ``c``;
+    every local gradient is corrected by ``c - c_i`` before the optimizer,
+    cancelling the client-specific drift direction that many local steps
+    on non-IID shards accumulate (the failure mode FedProx only damps).
+    Variate refresh is the paper's option I — ``c_i+ = grad_i(x)``, the
+    local gradient at the round-start server model — which stays exact
+    under ANY local optimizer (option II's ``(x - y_i)/(K*lr)`` closed
+    form assumes plain SGD steps). Runs on the delta path (plain identity
+    server update == the paper's eta_g=1; composes with FedOpt server
+    optimizers), full participation, uniform weighting, psum aggregation;
+    state must come from ``init_federated_state(..., scaffold=True)``.
+    The new-state invariant ``server_cv == mean_i(client_cv_i)`` holds
+    inductively from the zero init and is test-pinned.
     """
 
     local_train = make_local_train_step(apply_fn, tx, local_steps=local_steps,
-                                        prox_mu=prox_mu)
+                                        prox_mu=prox_mu, scaffold=scaffold)
     local_eval = make_local_eval_step(apply_fn, num_classes)
 
     sampling = participation_rate < 1.0
@@ -230,10 +276,38 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     all_reduce = make_all_reduce(aggregation, CLIENTS_AXIS, n_devices)
 
     delta_path = (server_opt is not None or dp_clip_norm > 0
-                  or dp_noise_multiplier > 0)
+                  or dp_noise_multiplier > 0 or scaffold)
     if dp_noise_multiplier > 0 and dp_clip_norm <= 0:
         raise ValueError("dp_noise_multiplier requires dp_clip_norm > 0 "
                          "(noise std is noise_multiplier * clip / weight)")
+    if scaffold:
+        if sampling:
+            # Partial-participation SCAFFOLD needs the |S|/N-scaled server
+            # variate update and stale-variate handling — not implemented;
+            # fail rather than silently run the full-participation rule.
+            raise ValueError("scaffold requires full participation "
+                             "(participation_rate=1.0)")
+        if weighting != "uniform":
+            raise ValueError("scaffold is defined over the uniform client "
+                             "mean (Karimireddy et al. 2020) — set "
+                             "weighting='uniform'")
+        if dp_clip_norm > 0 or dp_noise_multiplier > 0:
+            raise ValueError("scaffold + DP is not supported: the control "
+                             "variates are derived from raw local gradients "
+                             "and released unclipped/unnoised — an "
+                             "unaccounted privacy leak")
+        if compress != "none" or robust_aggregation != "none":
+            raise ValueError("scaffold composes with the plain delta path "
+                             "only (not compress/robust_aggregation)")
+        if aggregation != "psum":
+            raise ValueError("scaffold requires aggregation='psum' (the "
+                             "replicated server variate rides psum's "
+                             "provable replication, like server state)")
+        if byzantine_clients > 0:
+            raise ValueError("byzantine injection corrupts submitted "
+                             "updates but not variates — the attack model "
+                             "is incoherent under scaffold; use the robust "
+                             "rules to study poisoning")
     if delta_path and server_opt is None:
         # DP without an explicit server optimizer: pure averaging of the
         # clipped, noised deltas == FedAvg (see fedtpu.ops.server_opt).
@@ -307,7 +381,12 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     if byzantine_clients < 0:
         raise ValueError("byzantine_clients must be >= 0")
 
-    def round_body(params, opt_state, sstate, x, y, mask, rnd):
+    # SCAFFOLD variate refresh (option I): the local gradient of the plain
+    # CE at the round-START server model — exact under any local optimizer.
+    ce_grad = jax.grad(
+        lambda p, xx, yy, mm: masked_cross_entropy(apply_fn(p, xx), yy, mm))
+
+    def round_body(params, opt_state, sstate, ccv, scv, x, y, mask, rnd):
         # Shapes here are per-device blocks: leading axis Cb = C / n_devices.
         # The batch is scan-invariant (full-batch training): close over it so
         # XLA treats it as a loop constant instead of threading it as carry.
@@ -317,10 +396,35 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
         gidx = jax.lax.axis_index(CLIENTS_AXIS) * cb + jnp.arange(cb)
 
         def one_round(carry, _):
-            params, opt_state, sstate, r = carry
+            params, opt_state, sstate, ccv, scv, r = carry
             start = params           # delta path: every slot holds the server model
-            trained, new_opt, loss = jax.vmap(local_train)(
-                params, opt_state, x, y, mask)
+            if scaffold:
+                # Correction c - c_i enters every local gradient; variates
+                # then refresh from the gradient at the shared round start.
+                corr = jax.tree.map(lambda cv, ci: cv[None] - ci, scv, ccv)
+                trained, new_opt, loss = jax.vmap(local_train)(
+                    params, opt_state, x, y, mask, corr)
+                ci_plus = jax.vmap(ce_grad)(start, x, y, mask)
+                num_clients = cb * n_devices
+
+                def cv_mean(d):
+                    # Reduce in f32 regardless of variate dtype, cast back
+                    # at the carry boundary (scan carries are dtype-exact).
+                    return (jax.lax.psum(d.astype(jnp.float32).sum(axis=0),
+                                         CLIENTS_AXIS) / num_clients)
+
+                # c+ = c + mean_i(c_i+ - c_i); with the zero init this keeps
+                # c == mean_i(c_i) inductively (full participation).
+                scv = jax.tree.map(
+                    lambda s, dm: (s + dm).astype(s.dtype), scv,
+                    jax.tree.map(cv_mean,
+                                 jax.tree.map(lambda a, b: a - b,
+                                              ci_plus, ccv)))
+                ccv = jax.tree.map(lambda n, o: n.astype(o.dtype),
+                                   ci_plus, ccv)
+            else:
+                trained, new_opt, loss = jax.vmap(local_train)(
+                    params, opt_state, x, y, mask)
 
             def per_client_where(cond, a, b):
                 # (Cb,) mask broadcast over each leaf's trailing dims.
@@ -550,23 +654,27 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
 
                 params = jax.tree.map(avg, agg_params)
             pooled_conf = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)
-            return (params, opt_state, sstate, r + 1), (loss, conf,
-                                                        pooled_conf)
+            return (params, opt_state, sstate, ccv, scv, r + 1), (
+                loss, conf, pooled_conf)
 
-        (params, opt_state, sstate, _), stacked = jax.lax.scan(
-            one_round, (params, opt_state, sstate, rnd),
+        (params, opt_state, sstate, ccv, scv, _), stacked = jax.lax.scan(
+            one_round, (params, opt_state, sstate, ccv, scv, rnd),
             length=rounds_per_step)
         loss, conf, pooled_conf = stacked        # leading axis = rounds R
-        return params, opt_state, sstate, loss, conf, pooled_conf
+        return params, opt_state, sstate, ccv, scv, loss, conf, pooled_conf
 
     spec_c = P(CLIENTS_AXIS)
     spec_rc = P(None, CLIENTS_AXIS)              # (rounds, clients, ...)
     sharded_body = jax.shard_map(
         round_body, mesh=mesh,
-        # sstate (server optimizer state) is replicated: it is derived only
-        # from all-reduced deltas, so every device computes it identically.
-        in_specs=(spec_c, spec_c, P(), spec_c, spec_c, spec_c, P()),
-        out_specs=(spec_c, spec_c, P(), spec_rc, spec_rc, P()),
+        # sstate (server optimizer state) and scv (SCAFFOLD server variate)
+        # are replicated: both derive only from all-reduced quantities, so
+        # every device computes them identically. ccv (per-client variates)
+        # shards over clients like params. When scaffold is off both
+        # variate slots are leafless () and the specs bind nothing.
+        in_specs=(spec_c, spec_c, P(), spec_c, P(), spec_c, spec_c, spec_c,
+                  P()),
+        out_specs=(spec_c, spec_c, P(), spec_c, P(), spec_rc, spec_rc, P()),
     )
 
     # Donate the state: every caller rebinds `state = round_step(state, ...)`,
@@ -595,9 +703,22 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 "start + mean(delta), which needs every client slot to "
                 "start the round at the shared global — build the state "
                 "with init_federated_state(..., shared_start=True)")
+        if scaffold and "client_cv" not in state:
+            raise ValueError(
+                "scaffold needs control-variate state — build it with "
+                "init_federated_state(..., scaffold=True)")
+        if not scaffold and "client_cv" in state:
+            raise ValueError(
+                "state holds control variates (built with scaffold=True) "
+                "but this round_fn was built without scaffold — the "
+                "variates would silently stop updating; build the "
+                "round_fn with scaffold=True")
         sstate = state.get("server_opt_state", ())
-        params, opt_state, sstate, loss, conf, pooled_conf = sharded_body(
-            state["params"], state["opt_state"], sstate,
+        ccv = state.get("client_cv", ())
+        scv = state.get("server_cv", ())
+        (params, opt_state, sstate, ccv, scv, loss, conf,
+         pooled_conf) = sharded_body(
+            state["params"], state["opt_state"], sstate, ccv, scv,
             batch["x"], batch["y"], batch["mask"], state["round"])
         metrics = assemble_metrics(loss, conf, pooled_conf, batch["mask"],
                                    rounds_per_step)
@@ -605,6 +726,9 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                      "round": state["round"] + rounds_per_step}
         if delta_path:
             new_state["server_opt_state"] = sstate
+        if scaffold:
+            new_state["client_cv"] = ccv
+            new_state["server_cv"] = scv
         if "shared_start" in state:
             new_state["shared_start"] = ()
         return new_state, metrics

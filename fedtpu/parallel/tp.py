@@ -188,7 +188,11 @@ def init_federated_state_2d(key: jax.Array, mesh: Mesh, num_clients: int,
              "round": jnp.zeros((), jnp.int32)}
     if server_opt is not None:
         g0 = jax.tree.map(lambda p: p[0], params)
-        sstate0 = server_opt.init(g0)
+        # f32 server accumulators regardless of param dtype, matching the
+        # 1-D engine: the delta reduction is f32, so a bf16-born server
+        # state would change dtype across the scan carry.
+        sstate0 = jax.tree.map(lambda t: t.astype(jnp.float32),
+                               server_opt.init(g0))
         sspecs = jax.tree.map(drop_client_axis, specs)
         state["server_opt_state"] = jax.tree.map(
             lambda t, s: jax.device_put(t, NamedSharding(mesh, s)),

@@ -33,7 +33,8 @@ from fedtpu.ops.metrics import confusion_matrix
 def make_local_train_step(apply_fn: Callable,
                           tx: optax.GradientTransformation,
                           local_steps: int = 1,
-                          prox_mu: float = 0.0) -> Callable:
+                          prox_mu: float = 0.0,
+                          scaffold: bool = False) -> Callable:
     """Returns ``step(params, opt_state, x, y, mask) ->
     (params, opt_state, loss)`` — ``local_steps`` full-batch updates.
 
@@ -44,7 +45,15 @@ def make_local_train_step(apply_fn: Callable,
     StepLR does (:73). ``prox_mu`` adds the FedProx proximal term
     ``mu/2 * ||w - w_global||^2`` against the round-start params — zero
     gradient at the anchor, so it only matters when ``local_steps > 1``
-    (it bounds client drift on non-IID shards)."""
+    (it bounds client drift on non-IID shards).
+
+    ``scaffold=True`` changes the signature to ``step(params, opt_state,
+    x, y, mask, correction)``: the SCAFFOLD drift correction
+    ``c - c_i`` (a params-shaped pytree) is ADDED to the raw gradient
+    before the optimizer sees it — Karimireddy et al. 2020's local rule
+    ``y <- y - lr*(g(y) - c_i + c)``, generalized to any optax optimizer
+    by correcting the gradient rather than hardcoding SGD. The variate
+    bookkeeping lives in the round engine (fedtpu.parallel.round)."""
 
     if local_steps < 1:
         raise ValueError(f"local_steps must be >= 1, got {local_steps}")
@@ -52,7 +61,7 @@ def make_local_train_step(apply_fn: Callable,
         raise ValueError(f"prox_mu must be >= 0, got {prox_mu} "
                          "(negative mu amplifies drift instead of bounding it)")
 
-    def step(params, opt_state, x, y, mask):
+    def step(params, opt_state, x, y, mask, correction=None):
         anchor = params
 
         def one(carry, _):
@@ -72,6 +81,12 @@ def make_local_train_step(apply_fn: Callable,
                 return obj, ce
 
             (_, ce), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            if scaffold:
+                # Cast-preserving add: the optimizer's state dtypes follow
+                # the grad dtypes, so the correction must not promote them
+                # (bf16 params + f32-reduced variates would).
+                grads = jax.tree.map(lambda g, c: (g + c).astype(g.dtype),
+                                     grads, correction)
             updates, s = tx.update(grads, s, p)
             return (optax.apply_updates(p, updates), s), ce
 

@@ -140,6 +140,9 @@ QUICK_TESTS = {
     "test_tp.py::test_mesh_2d_shape",
     "test_tp.py::test_unsupported_combos_raise",
     "test_tp.py::test_per_device_state_bytes_scale_down_with_tp",
+    # round-4 modules
+    "test_scaffold.py::test_server_cv_is_mean_of_client_cv",
+    "test_scaffold.py::test_incompatible_combos_raise",
     # test_multihost_e2e spawns 2 OS processes (~70 s for the round-kernel
     # worker since the int8/Byzantine sections joined) and stays full-tier
     # only; fedtpu/parallel/multihost.py is covered above in-process.

@@ -1,0 +1,287 @@
+"""SCAFFOLD control-variate drift correction (fedtpu.parallel.round,
+Karimireddy et al. 2020, option-I variates).
+
+The pins, in order of how much they constrain the implementation:
+
+1. EXACT: at local_steps=1 with plain SGD the aggregated global trajectory
+   equals FedAvg's — per-client corrections (c - c_i) cancel in the client
+   mean because c == mean(c_i). Any sign/placement error breaks this.
+2. EXACT: with identical shards + same_init the corrections are
+   identically zero and scaffold == the plain delta path, any optimizer.
+3. INVARIANT: server_cv == mean(client_cv) after every round (the paper's
+   c = mean(c_i) under full participation, from the zero init).
+4. BENEFIT (falsifiable): single-class clients + many local steps —
+   maximal heterogeneity — where FedAvg stalls at a drift-biased point;
+   scaffold settles 1.40x closer to global stationarity (measured by
+   |grad F| at the final global). Deterministic seeds; no flake surface.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                           ModelConfig, OptimConfig, RunConfig, ShardConfig)
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.ops.server_opt import identity_server_optimizer
+from fedtpu.orchestration.loop import build_experiment, run_experiment
+from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.parallel.round import (build_round_fn, global_params,
+                                   init_federated_state)
+
+
+def _setup(scaffold, optim=None, hidden=(16, 8), num_clients=8, seed=1,
+           label_sort=True, rows=512, features=8, identical_shards=False):
+    x, y = synthetic_income_like(rows, features, 2, seed=seed)
+    if label_sort:
+        order = np.argsort(y, kind="stable")
+        x, y = x[order], y[order]
+    if identical_shards:
+        n = rows // num_clients
+        x = np.tile(x[:n], (num_clients, 1)).reshape(num_clients, n, features)
+        y = np.tile(y[:n], num_clients).reshape(num_clients, n)
+        batch_np = {"x": x, "y": y,
+                    "mask": np.ones((num_clients, n), np.float32)}
+    else:
+        packed = pack_clients(x, y, ShardConfig(num_clients=num_clients,
+                                                shuffle=False))
+        batch_np = {"x": packed.x, "y": packed.y, "mask": packed.mask}
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=features,
+                                                hidden_sizes=hidden))
+    tx = build_optimizer(optim or OptimConfig(name="sgd", learning_rate=0.05,
+                                              momentum=0.0))
+    mesh = make_mesh(num_clients=num_clients)
+    server = identity_server_optimizer()
+    state = init_federated_state(jax.random.key(0), mesh, num_clients,
+                                 init_fn, tx, same_init=True,
+                                 server_opt=server, scaffold=scaffold)
+    batch = {k: jax.device_put(v, client_sharding(mesh))
+             for k, v in batch_np.items()}
+    return mesh, apply_fn, tx, server, state, batch
+
+
+def _global(mesh, apply_fn, tx, server, state, batch, scaffold, **kw):
+    step = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                          server_opt=server, scaffold=scaffold, **kw)
+    state, _ = step(state, batch)
+    return state
+
+
+@pytest.mark.parametrize("rounds", [10])
+def test_e1_sgd_global_trajectory_equals_fedavg(rounds):
+    """Pin 1: E=1 + SGD -> corrections cancel in the client mean; the
+    GLOBAL model is bit-near FedAvg's even though per-client locals differ."""
+    outs = {}
+    for scaf in (False, True):
+        args = _setup(scaf)
+        state = _global(*args, scaffold=scaf, local_steps=1,
+                        rounds_per_step=rounds)
+        outs[scaf] = jax.tree.map(np.asarray, global_params(state))
+    for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
+        np.testing.assert_allclose(a, b, atol=5e-7)
+
+
+def test_identical_shards_corrections_vanish_any_optimizer():
+    """Pin 2: identical shards + same_init -> c_i == c always, corrections
+    exactly zero -> scaffold == plain delta path under Adam too."""
+    outs = {}
+    for scaf in (False, True):
+        args = _setup(scaf, optim=OptimConfig(), identical_shards=True,
+                      label_sort=False)
+        state = _global(*args, scaffold=scaf, local_steps=4,
+                        rounds_per_step=5)
+        outs[scaf] = jax.tree.map(np.asarray, global_params(state))
+    for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
+        np.testing.assert_allclose(a, b, atol=1e-7)
+
+
+def test_server_cv_is_mean_of_client_cv():
+    """Pin 3: the paper's invariant c == mean_i(c_i), inductive from the
+    zero init under full participation."""
+    args = _setup(True)
+    state = _global(*args, scaffold=True, local_steps=4, rounds_per_step=7)
+    mean_ccv = jax.tree.map(lambda c: np.asarray(c).mean(axis=0),
+                            state["client_cv"])
+    for a, b in zip(jax.tree.leaves(mean_ccv),
+                    jax.tree.leaves(jax.tree.map(np.asarray,
+                                                 state["server_cv"]))):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    # And the variates are alive, not zeros (they carry real gradients).
+    assert max(float(np.abs(np.asarray(l)).max())
+               for l in jax.tree.leaves(state["client_cv"])) > 1e-4
+
+
+def test_scaffold_lowers_the_drift_floor():
+    """Pin 4 (falsifiable benefit): single-class clients (4-class task,
+    label-sorted over 8 clients) + E=32 local steps is maximal
+    heterogeneity; plain FedAvg stalls where the drift bias balances the
+    descent — a point with |grad F| bounded away from stationarity — while
+    SCAFFOLD's corrected dynamics settle measurably closer to a stationary
+    point of the GLOBAL objective. Measured (identical on CPU and v5e,
+    stable from round 50 through 300): |grad F| 3.50e-1 vs 2.49e-1 —
+    a 1.40x lower floor. Assert 1.15x so only a real regression trips.
+
+    (Accuracy is the wrong observable here: a binary linear model's argmax
+    is scale-invariant, and symmetric label-skew drift mostly inflates
+    scale — the runs that 'showed' accuracy gains in development were a
+    protocol bug, evaluating on a differently-seeded synthetic task.)"""
+    rng = np.random.default_rng(2)
+    centers = rng.normal(0.0, 0.8, size=(4, 8))
+    y = np.arange(512) % 4
+    rng.shuffle(y)
+    x = (centers[y] + rng.normal(0.0, 1.0, size=(512, 8))).astype(np.float32)
+    order = np.argsort(y, kind="stable")
+    packed = pack_clients(x[order], y[order].astype(np.int32),
+                          ShardConfig(num_clients=8, shuffle=False))
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=8, hidden_sizes=(),
+                                                num_classes=4))
+    from fedtpu.ops.losses import masked_cross_entropy
+    import jax.numpy as jnp
+    gfn = jax.jit(jax.grad(lambda p: masked_cross_entropy(
+        apply_fn(p, packed.x.reshape(-1, 8)), packed.y.reshape(-1),
+        packed.mask.reshape(-1))))
+    floors = {}
+    for scaf in (False, True):
+        tx = build_optimizer(OptimConfig(name="sgd", learning_rate=0.05,
+                                         momentum=0.0))
+        mesh = make_mesh(num_clients=8)
+        server = identity_server_optimizer()
+        state = init_federated_state(jax.random.key(0), mesh, 8, init_fn, tx,
+                                     same_init=True, server_opt=server,
+                                     scaffold=scaf)
+        batch = {k: jax.device_put(v, client_sharding(mesh)) for k, v in
+                 {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+        step = build_round_fn(mesh, apply_fn, tx, 4, weighting="uniform",
+                              server_opt=server, scaffold=scaf,
+                              local_steps=32, rounds_per_step=100)
+        state, _ = step(state, batch)
+        g = global_params(state)
+        floors[scaf] = float(jnp.sqrt(sum(
+            jnp.sum(jnp.square(l)) for l in jax.tree.leaves(gfn(g)))))
+    assert floors[True] * 1.15 < floors[False], floors
+
+
+def test_incompatible_combos_raise():
+    mesh, apply_fn, tx, server, _, _ = _setup(True)
+    base = dict(weighting="uniform", server_opt=server, scaffold=True)
+    with pytest.raises(ValueError, match="full participation"):
+        build_round_fn(mesh, apply_fn, tx, 2, participation_rate=0.5, **base)
+    with pytest.raises(ValueError, match="uniform"):
+        build_round_fn(mesh, apply_fn, tx, 2, server_opt=server,
+                       scaffold=True, weighting="data_size")
+    with pytest.raises(ValueError, match="DP"):
+        build_round_fn(mesh, apply_fn, tx, 2, dp_clip_norm=1.0, **base)
+    with pytest.raises(ValueError, match="compress|robust"):
+        build_round_fn(mesh, apply_fn, tx, 2, compress="int8", **base)
+    with pytest.raises(ValueError, match="compress|robust"):
+        build_round_fn(mesh, apply_fn, tx, 2,
+                       robust_aggregation="median", **base)
+    with pytest.raises(ValueError, match="byzantine|incoherent"):
+        build_round_fn(mesh, apply_fn, tx, 2, byzantine_clients=1, **base)
+    with pytest.raises(ValueError, match="psum"):
+        build_round_fn(mesh, apply_fn, tx, 2, aggregation="ring", **base)
+
+
+def test_state_roundfn_mismatch_raises():
+    mesh, apply_fn, tx, server, state_scaf, batch = _setup(True)
+    plain = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                           server_opt=server)
+    with pytest.raises(ValueError, match="scaffold"):
+        plain(state_scaf, batch)
+    _, _, _, _, state_plain, _ = _setup(False)
+    scaf = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                          server_opt=server, scaffold=True)
+    with pytest.raises(ValueError, match="scaffold"):
+        scaf(state_plain, batch)
+    with pytest.raises(ValueError, match="delta path"):
+        init_federated_state(jax.random.key(0), mesh, 8,
+                             build_model(ModelConfig(input_dim=8))[0], tx,
+                             scaffold=True)
+
+
+def _cfg(**fed_kw):
+    return ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=8, shuffle=False),
+        model=ModelConfig(input_dim=6, hidden_sizes=(16, 8)),
+        fed=FedConfig(rounds=4, weighting="uniform", scaffold=True,
+                      local_steps=2, **fed_kw),
+        run=RunConfig(rounds_per_step=2),
+    )
+
+
+def test_run_experiment_scaffold_end_to_end(tmp_path):
+    """Full orchestration: scaffold trains, checkpoints carry the variates,
+    and a resumed run restores them (not zeros)."""
+    ck = str(tmp_path / "ck")
+    cfg = dataclasses.replace(
+        _cfg(), run=RunConfig(rounds_per_step=2, checkpoint_dir=ck,
+                              checkpoint_every=2))
+    res = run_experiment(cfg, verbose=False)
+    assert res.rounds_run == 4 and not res.diverged
+    assert 0.0 <= res.global_metrics["accuracy"][-1] <= 1.0
+
+    # Resume restores the saved variates into the live state.
+    exp = build_experiment(cfg)
+    assert "client_cv" in exp.state and "server_cv" in exp.state
+    from fedtpu.orchestration.checkpoint import load_checkpoint
+    state, _, step = load_checkpoint(ck, state_like=exp.state)
+    assert step == 4
+    assert max(float(np.abs(np.asarray(l)).max())
+               for l in jax.tree.leaves(state["client_cv"])) > 1e-6
+
+    cfg6 = dataclasses.replace(
+        cfg, fed=dataclasses.replace(cfg.fed, rounds=6))
+    res6 = run_experiment(cfg6, verbose=False, resume=True)
+    # rounds_run counts THROUGH training end incl. the 4 restored rounds.
+    assert res6.rounds_run == 6
+    assert len(res6.global_metrics["accuracy"]) == 6
+
+
+def test_model_parallel_scaffold_rejected():
+    cfg = dataclasses.replace(_cfg(), run=RunConfig(model_parallel=2))
+    with pytest.raises(ValueError, match="1-D engine"):
+        build_experiment(cfg)
+
+
+def test_scaffold_bf16_params_supported():
+    """Review r4: f32-hardcoded variates under bf16 params used to die in
+    XLA with an opaque scan-carry dtype mismatch. Variates now live in the
+    param dtype; one corrected round must execute and keep the invariant
+    (at bf16 tolerance)."""
+    import jax.numpy as jnp
+
+    x, y = synthetic_income_like(256, 6, 2, seed=0)
+    packed = pack_clients(x, y, ShardConfig(num_clients=8, shuffle=False))
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(16, 8),
+                                                param_dtype="bfloat16"))
+    tx = build_optimizer(OptimConfig(name="sgd", learning_rate=0.05,
+                                     momentum=0.0))
+    mesh = make_mesh(num_clients=8)
+    server = identity_server_optimizer()
+    state = init_federated_state(jax.random.key(0), mesh, 8, init_fn, tx,
+                                 same_init=True, server_opt=server,
+                                 scaffold=True)
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree.leaves(state["client_cv"]))
+    batch = {k: jax.device_put(v, client_sharding(mesh)) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    step = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                          server_opt=server, scaffold=True, local_steps=2,
+                          rounds_per_step=3)
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["client_mean"]["accuracy"][-1]))
+    mean_ccv = jax.tree.map(
+        lambda c: np.asarray(c, np.float32).mean(axis=0), state["client_cv"])
+    for a, b in zip(jax.tree.leaves(mean_ccv),
+                    jax.tree.leaves(jax.tree.map(
+                        lambda s: np.asarray(s, np.float32),
+                        state["server_cv"]))):
+        np.testing.assert_allclose(a, b, atol=2e-2)
