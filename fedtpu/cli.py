@@ -107,6 +107,24 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                         "is on (default 1e-5; pick << 1/num_clients; "
                         "rejected at parse time outside (0, 1) — the "
                         "accountant would refuse it after the whole run)")
+    p.add_argument("--dp-adaptive-clip", action="store_true", default=None,
+                   help="adaptive clipping (Andrew et al. 2021): the clip "
+                        "norm tracks --dp-target-quantile of client update "
+                        "norms, starting at --dp-clip-norm")
+    p.add_argument("--dp-target-quantile", type=_open_unit_float,
+                   default=None,
+                   help="quantile of update norms the adaptive clip tracks "
+                        "(default 0.5)")
+    p.add_argument("--dp-clip-lr", type=_nonnegative_float, default=None,
+                   help="geometric step size of the adaptive clip update "
+                        "(default 0.2)")
+    p.add_argument("--dp-count-noise-multiplier", type=_nonnegative_float,
+                   default=None,
+                   help="noise on the clipped-count release under adaptive "
+                        "clipping with DP noise on; must exceed "
+                        "dp_noise_multiplier/2 (the delta noise is then "
+                        "raised so the composed round charges exactly "
+                        "--dp-noise-multiplier)")
     p.add_argument("--compress", choices=["none", "int8"], default=None,
                    help="int8-quantize the update exchange (D/8 of the f32 "
                         "psum traffic at D devices; for few-host DCN-bound "
@@ -213,6 +231,16 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
     if args.dp_noise_multiplier is not None:
         fed = dataclasses.replace(fed,
                                   dp_noise_multiplier=args.dp_noise_multiplier)
+    if args.dp_adaptive_clip:
+        fed = dataclasses.replace(fed, dp_adaptive_clip=True)
+    if args.dp_target_quantile is not None:
+        fed = dataclasses.replace(fed,
+                                  dp_target_quantile=args.dp_target_quantile)
+    if args.dp_clip_lr is not None:
+        fed = dataclasses.replace(fed, dp_clip_lr=args.dp_clip_lr)
+    if args.dp_count_noise_multiplier is not None:
+        fed = dataclasses.replace(
+            fed, dp_count_noise_multiplier=args.dp_count_noise_multiplier)
     if args.compress is not None:
         fed = dataclasses.replace(fed, compress=args.compress)
     if args.robust_aggregation is not None:

@@ -178,6 +178,19 @@ class FedConfig:
     dp_clip_norm: float = 0.0
     dp_noise_multiplier: float = 0.0
     dp_seed: int = 0
+    # Adaptive clipping (Andrew et al. 2021): the clip norm becomes server
+    # state initialized at dp_clip_norm and tracking the dp_target_quantile
+    # of client update norms via clip *= exp(-dp_clip_lr * (b - quantile)),
+    # where b is the (noisy) clipped fraction. With DP noise on, the budget
+    # splits between the delta release (effective z_delta) and the
+    # unit-sensitivity count (dp_count_noise_multiplier, must be > z/2) so
+    # the composition charges exactly dp_noise_multiplier per round — the
+    # accountant is unchanged. With noise off it is plain quantile tracking
+    # (exact fraction; count noise must be 0). 1-D engine only.
+    dp_adaptive_clip: bool = False
+    dp_target_quantile: float = 0.5
+    dp_clip_lr: float = 0.2
+    dp_count_noise_multiplier: float = 0.0
     # Target delta for the RDP accountant's (epsilon, delta) report
     # (fedtpu.ops.dp_accountant; surfaced in the run summary whenever DP
     # noise is on). Pick delta << 1/num_clients for a meaningful client-

@@ -105,6 +105,9 @@ class ExperimentResult:
     # segments: the reported (noise_multiplier, sampling_rate) describe
     # only the current segment and cannot re-derive the epsilon alone.
     dp_composed: bool = False
+    # Final adaptive clip norm (FedConfig.dp_adaptive_clip); None when
+    # adaptive clipping is off.
+    final_dp_clip: Optional[float] = None
 
     def summary(self) -> dict:
         last = {k: v[-1] for k, v in self.global_metrics.items() if v}
@@ -125,6 +128,8 @@ class ExperimentResult:
             "mean_sec_per_round": float(np.mean(steady)),
             **extra,
             **({"dp": dp} if dp else {}),
+            **({"final_dp_clip": self.final_dp_clip}
+               if self.final_dp_clip is not None else {}),
         }
 
     def privacy_spent(self) -> dict:
@@ -216,6 +221,11 @@ def build_experiment(cfg: ExperimentConfig,
     if cfg.fed.dp_noise_multiplier > 0 and cfg.fed.dp_clip_norm <= 0:
         raise ValueError("dp_noise_multiplier requires dp_clip_norm > 0 "
                          "(noise std is noise_multiplier * clip / weight)")
+    if cfg.fed.dp_adaptive_clip and cfg.fed.dp_clip_norm <= 0:
+        # Fail before state init (its adaptive_clip_init guard fires first
+        # otherwise, with a less actionable message).
+        raise ValueError("dp_adaptive_clip needs dp_clip_norm > 0 as the "
+                         "initial clip")
 
     # Server optimizer / DP delta path: shared by both engines.
     server = None
@@ -255,6 +265,9 @@ def build_experiment(cfg: ExperimentConfig,
         if cfg.fed.scaffold:
             raise ValueError("scaffold requires the 1-D engine "
                              "(model_parallel=1)")
+        if cfg.fed.dp_adaptive_clip:
+            raise ValueError("dp_adaptive_clip requires the 1-D engine "
+                             "(model_parallel=1)")
         # Only dims the tp specs actually place on the 'model' axis need to
         # divide: the col-sharded out-dims (even indices — row layers shard
         # the PREVIOUS layer's out-dim, already covered) plus, for convnets,
@@ -290,7 +303,9 @@ def build_experiment(cfg: ExperimentConfig,
             jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
             init_fn, tx, same_init=cfg.fed.same_init, server_opt=server,
             shared_start=cfg.fed.compress != "none",
-            scaffold=cfg.fed.scaffold)
+            scaffold=cfg.fed.scaffold,
+            adaptive_clip_init=(cfg.fed.dp_clip_norm
+                                if cfg.fed.dp_adaptive_clip else None))
         step_fn = lambda r: build_round_fn(
             mesh, apply_fn, tx, ds.num_classes, weighting=cfg.fed.weighting,
             rounds_per_step=r,
@@ -303,6 +318,10 @@ def build_experiment(cfg: ExperimentConfig,
             dp_clip_norm=cfg.fed.dp_clip_norm,
             dp_noise_multiplier=cfg.fed.dp_noise_multiplier,
             dp_seed=cfg.fed.dp_seed,
+            dp_adaptive_clip=cfg.fed.dp_adaptive_clip,
+            dp_target_quantile=cfg.fed.dp_target_quantile,
+            dp_clip_lr=cfg.fed.dp_clip_lr,
+            dp_count_noise_multiplier=cfg.fed.dp_count_noise_multiplier,
             compress=cfg.fed.compress,
             robust_aggregation=cfg.fed.robust_aggregation,
             trim_ratio=cfg.fed.trim_ratio,
@@ -495,6 +514,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                         lambda live, rawv: jax.device_put(
                             np.asarray(rawv), live.sharding),
                         state["server_opt_state"], raw["server_opt_state"])
+                if "dp_clip" in raw and "dp_clip" in state:
+                    # The adaptive clip is client-count-independent server
+                    # state — carry it like the server optimizer state.
+                    state["dp_clip"] = jax.device_put(
+                        np.asarray(raw["dp_clip"]),
+                        state["dp_clip"].sharding)
                 state["round"] = jnp.asarray(raw_round, jnp.int32)
                 restored_history, start_round = raw_history, raw_round
                 if verbose:
@@ -542,7 +567,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         return not bool(_tree_finite(
             {k: state[k] for k in
              ("params", "opt_state", "server_opt_state",
-              "client_cv", "server_cv") if k in state}))
+              "client_cv", "server_cv", "dp_clip") if k in state}))
 
     def halt_diverged(reason: str, label_round: int):
         """Shared divergence halt: quarantine the poisoned state under
@@ -901,6 +926,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         # early stop's overshoot chunk; the DP accountant must count it).
         rounds_trained=int(np.asarray(jax.device_get(_rep(state["round"])))),
         dp_base_assumed=ledger.base_assumed,
+        final_dp_clip=(float(np.asarray(jax.device_get(
+            _rep(state["dp_clip"])))) if "dp_clip" in state else None),
     )
     result = dataclasses.replace(
         result, dp_rdp_total=ledger.rdp_at(result.rounds_trained),

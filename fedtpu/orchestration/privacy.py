@@ -48,6 +48,12 @@ class PrivacyLedger:
 
     def __init__(self, fed, start_round: int = 0,
                  restored_meta: Optional[dict] = None):
+        # Charging the CONFIGURED dp_noise_multiplier is correct under
+        # adaptive clipping too: the engine calibrates the delta noise at
+        # the effective z_delta and the clipped-count at z_count such that
+        # the per-round composition equals one Gaussian mechanism of the
+        # configured z (fedtpu.parallel.round.
+        # effective_delta_noise_multiplier, Andrew et al. 2021).
         self._noise_on = fed.dp_noise_multiplier > 0
         self.per_step = (np.asarray(rdp_vector(fed.participation_rate,
                                                fed.dp_noise_multiplier))

@@ -51,6 +51,28 @@ from fedtpu.training.client import make_local_train_step, make_local_eval_step
 # PRNG domain-separation tag for the DP noise stream (vs the participation
 # stream, which folds the round index directly into key(participation_seed)).
 _DP_NOISE_STREAM = 0x6E6F6973  # "nois"
+# Separate stream for the adaptive-clip count noise (the clipped-fraction
+# release is its own mechanism; its draw must be independent of the delta
+# noise at the same round index).
+_DP_COUNT_STREAM = 0x636E7420  # "cnt "
+
+
+def effective_delta_noise_multiplier(z: float, z_count: float) -> float:
+    """Andrew et al. 2021 (adaptive clipping) split-noise calibration: to
+    release BOTH the noised mean delta and the noised clipped-fraction with
+    a total privacy cost equal to a single Gaussian mechanism of noise
+    multiplier ``z``, the delta noise runs at
+    ``z_delta = (z^-2 - (2*z_count)^-2)^-1/2`` while the unit-sensitivity
+    count sum takes ``z_count``. Requires ``z_count > z/2`` (else the count
+    mechanism alone exceeds the budget). The RDP accountant keeps charging
+    the configured ``z`` — the composition theorem is exactly this
+    identity: z^-2 == z_delta^-2 + (2*z_count)^-2."""
+    if z_count <= z / 2:
+        raise ValueError(
+            f"dp_count_noise_multiplier must exceed dp_noise_multiplier/2 "
+            f"(got z_count={z_count} vs z={z}): the clipped-count release "
+            "alone would exceed the per-round budget z")
+    return (z ** -2 - (2.0 * z_count) ** -2) ** -0.5
 
 # Smoothed-Weiszfeld iteration budget for geometric_median. Fixed (not a
 # data-dependent stopping rule) so the scan stays compiler-friendly.
@@ -88,7 +110,8 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
                          same_init: bool = False,
                          server_opt: ServerOptimizer | None = None,
                          shared_start: bool = False,
-                         scaffold: bool = False):
+                         scaffold: bool = False,
+                         adaptive_clip_init: float | None = None):
     """Per-client params + optimizer state, leading axis = clients, sharded.
 
     ``same_init=False`` matches the reference, where every rank constructs an
@@ -111,6 +134,10 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
     ``client_cv`` (per-client, sharded like params) and ``server_cv``
     (their replicated mean). Requires ``server_opt`` (the delta path) —
     see ``build_round_fn(scaffold=True)``.
+
+    ``adaptive_clip_init`` adds the replicated ``dp_clip`` scalar for
+    adaptive DP clipping (``build_round_fn(dp_adaptive_clip=True)``),
+    initialized at the given value (the config's ``dp_clip_norm``).
     """
     params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
     opt_state = jax.vmap(tx.init)(params)
@@ -157,6 +184,14 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
             lambda g: jax.device_put(jnp.zeros(g.shape, g.dtype),
                                      NamedSharding(mesh, P())),
             jax.tree.map(lambda p: p[0], params))
+    if adaptive_clip_init is not None:
+        if adaptive_clip_init <= 0:
+            raise ValueError(f"adaptive_clip_init must be > 0, got "
+                             f"{adaptive_clip_init}")
+        from jax.sharding import NamedSharding
+        state["dp_clip"] = jax.device_put(
+            jnp.asarray(adaptive_clip_init, jnp.float32),
+            NamedSharding(mesh, P()))
     return state
 
 
@@ -172,6 +207,10 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                    dp_clip_norm: float = 0.0,
                    dp_noise_multiplier: float = 0.0,
                    dp_seed: int = 0,
+                   dp_adaptive_clip: bool = False,
+                   dp_target_quantile: float = 0.5,
+                   dp_clip_lr: float = 0.2,
+                   dp_count_noise_multiplier: float = 0.0,
                    compress: str = "none",
                    robust_aggregation: str = "none",
                    trim_ratio: float = 0.1,
@@ -244,6 +283,17 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     sign-flipped update (a strong model-poisoning attack) while their local
     metrics stay honest — the knob that lets tests and chaos runs prove the
     robust rules hold and the plain mean breaks.
+
+    ``dp_adaptive_clip=True`` — adaptive clipping (Andrew et al. 2021):
+    the clip norm becomes replicated server state (from
+    ``init_federated_state(..., adaptive_clip_init=dp_clip_norm)``)
+    tracking the ``dp_target_quantile`` of client update norms via
+    ``clip *= exp(-dp_clip_lr * (b_noisy - quantile))``. With DP noise
+    the per-round budget splits between the delta release and the
+    unit-sensitivity clipped-count (``dp_count_noise_multiplier``) via
+    ``effective_delta_noise_multiplier`` so the composition charges
+    exactly the configured ``dp_noise_multiplier`` — the accountant needs
+    no change. Without noise it is plain quantile tracking.
 
     ``scaffold=True`` — SCAFFOLD (Karimireddy et al. 2020): each client
     carries a control variate ``c_i`` (an estimate of its own shard's
@@ -325,6 +375,38 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     # per-client sensitivity bound clip/denominator must be client-agnostic).
     # Under the fixed denominator, zero-participant rounds still release
     # noise — that IS the mechanism, not a bug.
+    # Adaptive clipping (Andrew et al. 2021): the clip norm becomes server
+    # state tracking the dp_target_quantile of client update norms via the
+    # geometric rule clip *= exp(-dp_clip_lr * (b_noisy - quantile)), where
+    # b is the clipped-fraction (unit-sensitivity count). With DP noise on,
+    # the budget splits: deltas run at the effective z_delta and the count
+    # at z_count so the composition charges exactly the configured z (the
+    # accountant is unchanged). With noise off it is plain quantile
+    # tracking (exact fraction, no count noise allowed).
+    dp_z_delta = dp_noise_multiplier
+    if dp_adaptive_clip:
+        if dp_clip_norm <= 0:
+            raise ValueError("dp_adaptive_clip needs dp_clip_norm > 0 as "
+                             "the initial clip")
+        if not 0.0 < dp_target_quantile < 1.0:
+            raise ValueError(f"dp_target_quantile must be in (0, 1), got "
+                             f"{dp_target_quantile}")
+        if dp_clip_lr <= 0:
+            raise ValueError(f"dp_clip_lr must be > 0, got {dp_clip_lr}")
+        if dp_noise_multiplier > 0:
+            dp_z_delta = effective_delta_noise_multiplier(
+                dp_noise_multiplier, dp_count_noise_multiplier)
+        elif dp_count_noise_multiplier != 0:
+            raise ValueError("dp_count_noise_multiplier without "
+                             "dp_noise_multiplier is meaningless: with no "
+                             "delta noise there is no privacy budget to "
+                             "split — set both or neither")
+        if compress != "none" or robust_aggregation != "none":
+            raise ValueError("dp_adaptive_clip composes with the plain "
+                             "delta path only")
+    elif dp_count_noise_multiplier != 0:
+        raise ValueError("dp_count_noise_multiplier requires "
+                         "dp_adaptive_clip=True")
     dp_fixed_denom = dp_clip_norm > 0 and sampling
     if dp_fixed_denom and weighting != "uniform":
         raise ValueError("DP with partial participation requires "
@@ -386,7 +468,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     ce_grad = jax.grad(
         lambda p, xx, yy, mm: masked_cross_entropy(apply_fn(p, xx), yy, mm))
 
-    def round_body(params, opt_state, sstate, ccv, scv, x, y, mask, rnd):
+    def round_body(params, opt_state, sstate, ccv, scv, dpc, x, y, mask,
+                   rnd):
         # Shapes here are per-device blocks: leading axis Cb = C / n_devices.
         # The batch is scan-invariant (full-batch training): close over it so
         # XLA treats it as a loop constant instead of threading it as carry.
@@ -396,7 +479,7 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
         gidx = jax.lax.axis_index(CLIENTS_AXIS) * cb + jnp.arange(cb)
 
         def one_round(carry, _):
-            params, opt_state, sstate, ccv, scv, r = carry
+            params, opt_state, sstate, ccv, scv, dpc, r = carry
             start = params           # delta path: every slot holds the server model
             if scaffold:
                 # Correction c - c_i enters every local gradient; variates
@@ -480,8 +563,9 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 denom = (participation_rate * cb * n_devices
                          if dp_fixed_denom else jnp.maximum(total_w, 1.0))
                 delta = jax.tree.map(lambda t, s: t - s, agg_params, start)
+                clip_t = dpc if dp_adaptive_clip else dp_clip_norm
                 if dp_clip_norm > 0:
-                    delta, _ = clip_by_global_norm(delta, dp_clip_norm)
+                    delta, dnorms = clip_by_global_norm(delta, clip_t)
 
                 def mean_delta_leaf(d):
                     local = jnp.tensordot(w.astype(jnp.float32),
@@ -490,7 +574,10 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
 
                 mean_delta = jax.tree.map(mean_delta_leaf, delta)
                 if dp_noise_multiplier > 0:
-                    std = dp_noise_multiplier * dp_clip_norm / denom
+                    # Adaptive clipping splits the budget: deltas take the
+                    # effective z_delta (> z) so that together with the
+                    # count release below the round charges exactly z.
+                    std = dp_z_delta * clip_t / denom
                     # Domain-separate the noise stream from the
                     # participation stream (same fold_in(key(seed), r)
                     # shape; both seeds default 0): fold a fixed tag in
@@ -502,6 +589,34 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     mean_delta = jax.tree.map(
                         jnp.add, mean_delta,
                         gaussian_noise_tree(noise_key, mean_delta, std))
+                if dp_adaptive_clip:
+                    # Noisy clipped-fraction b (unit-sensitivity count over
+                    # participants), then the geometric quantile step
+                    # clip *= exp(-lr * (b - quantile)) — Andrew et al.'s
+                    # update toward the dp_target_quantile of update norms.
+                    # b is a COUNT fraction: its denominator is the
+                    # participant count (fixed q*C under DP+sampling),
+                    # never the data-size weight — a weight denominator
+                    # under weighting='data_size' would divide ~num_clients
+                    # clipped clients by the total SAMPLE count, pinning
+                    # b near 0 and growing the clip without bound
+                    # (review r4).
+                    present = (w > 0).astype(jnp.float32)
+                    count = jax.lax.psum(present.sum(), CLIENTS_AXIS)
+                    denom_b = (participation_rate * cb * n_devices
+                               if dp_fixed_denom
+                               else jnp.maximum(count, 1.0))
+                    b_sum = jax.lax.psum(
+                        (present * (dnorms <= clip_t)).sum(), CLIENTS_AXIS)
+                    if dp_count_noise_multiplier > 0:
+                        count_key = jax.random.fold_in(
+                            jax.random.fold_in(jax.random.key(dp_seed),
+                                               _DP_COUNT_STREAM), r)
+                        b_sum = b_sum + (dp_count_noise_multiplier
+                                         * jax.random.normal(count_key))
+                    b = b_sum / denom_b
+                    dpc = dpc * jnp.exp(
+                        -dp_clip_lr * (b - dp_target_quantile))
                 new_step, new_sstate = server_opt.update(mean_delta, sstate)
                 if sampling and not dp_fixed_denom:
                     # Plain FedOpt under sampling: a zero-participant round
@@ -654,27 +769,30 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
 
                 params = jax.tree.map(avg, agg_params)
             pooled_conf = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)
-            return (params, opt_state, sstate, ccv, scv, r + 1), (
+            return (params, opt_state, sstate, ccv, scv, dpc, r + 1), (
                 loss, conf, pooled_conf)
 
-        (params, opt_state, sstate, ccv, scv, _), stacked = jax.lax.scan(
-            one_round, (params, opt_state, sstate, ccv, scv, rnd),
+        (params, opt_state, sstate, ccv, scv, dpc, _), stacked = jax.lax.scan(
+            one_round, (params, opt_state, sstate, ccv, scv, dpc, rnd),
             length=rounds_per_step)
         loss, conf, pooled_conf = stacked        # leading axis = rounds R
-        return params, opt_state, sstate, ccv, scv, loss, conf, pooled_conf
+        return (params, opt_state, sstate, ccv, scv, dpc, loss, conf,
+                pooled_conf)
 
     spec_c = P(CLIENTS_AXIS)
     spec_rc = P(None, CLIENTS_AXIS)              # (rounds, clients, ...)
     sharded_body = jax.shard_map(
         round_body, mesh=mesh,
-        # sstate (server optimizer state) and scv (SCAFFOLD server variate)
-        # are replicated: both derive only from all-reduced quantities, so
-        # every device computes them identically. ccv (per-client variates)
-        # shards over clients like params. When scaffold is off both
-        # variate slots are leafless () and the specs bind nothing.
-        in_specs=(spec_c, spec_c, P(), spec_c, P(), spec_c, spec_c, spec_c,
-                  P()),
-        out_specs=(spec_c, spec_c, P(), spec_c, P(), spec_rc, spec_rc, P()),
+        # sstate (server optimizer state), scv (SCAFFOLD server variate),
+        # and dpc (adaptive clip scalar) are replicated: all derive only
+        # from all-reduced quantities, so every device computes them
+        # identically. ccv (per-client variates) shards over clients like
+        # params. Disabled features pass leafless () and their specs bind
+        # nothing.
+        in_specs=(spec_c, spec_c, P(), spec_c, P(), P(), spec_c, spec_c,
+                  spec_c, P()),
+        out_specs=(spec_c, spec_c, P(), spec_c, P(), P(), spec_rc, spec_rc,
+                   P()),
     )
 
     # Donate the state: every caller rebinds `state = round_step(state, ...)`,
@@ -713,12 +831,23 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                 "but this round_fn was built without scaffold — the "
                 "variates would silently stop updating; build the "
                 "round_fn with scaffold=True")
+        if dp_adaptive_clip and "dp_clip" not in state:
+            raise ValueError(
+                "dp_adaptive_clip needs the clip state — build it with "
+                "init_federated_state(..., adaptive_clip_init=...)")
+        if not dp_adaptive_clip and "dp_clip" in state:
+            raise ValueError(
+                "state carries an adaptive clip (built with "
+                "adaptive_clip_init=...) but this round_fn was built "
+                "without dp_adaptive_clip — the clip would silently "
+                "freeze; build the round_fn with dp_adaptive_clip=True")
         sstate = state.get("server_opt_state", ())
         ccv = state.get("client_cv", ())
         scv = state.get("server_cv", ())
-        (params, opt_state, sstate, ccv, scv, loss, conf,
+        dpc = state.get("dp_clip", ())
+        (params, opt_state, sstate, ccv, scv, dpc, loss, conf,
          pooled_conf) = sharded_body(
-            state["params"], state["opt_state"], sstate, ccv, scv,
+            state["params"], state["opt_state"], sstate, ccv, scv, dpc,
             batch["x"], batch["y"], batch["mask"], state["round"])
         metrics = assemble_metrics(loss, conf, pooled_conf, batch["mask"],
                                    rounds_per_step)
@@ -729,6 +858,8 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
         if scaffold:
             new_state["client_cv"] = ccv
             new_state["server_cv"] = scv
+        if dp_adaptive_clip:
+            new_state["dp_clip"] = dpc
         if "shared_start" in state:
             new_state["shared_start"] = ()
         return new_state, metrics

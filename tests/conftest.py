@@ -143,6 +143,8 @@ QUICK_TESTS = {
     # round-4 modules
     "test_scaffold.py::test_server_cv_is_mean_of_client_cv",
     "test_scaffold.py::test_incompatible_combos_raise",
+    "test_adaptive_clip.py::test_effective_delta_noise_multiplier_identity",
+    "test_adaptive_clip.py::test_one_round_clip_update_matches_oracle",
     # test_multihost_e2e spawns 2 OS processes (~70 s for the round-kernel
     # worker since the int8/Byzantine sections joined) and stays full-tier
     # only; fedtpu/parallel/multihost.py is covered above in-process.

@@ -1,0 +1,221 @@
+"""Adaptive DP clipping (Andrew et al. 2021) — fedtpu.parallel.round.
+
+Pins: the one-round oracle (clip update recomputed from independently
+derived client update norms), the long-run equilibrium (the clip settles
+inside the update-norm distribution, bracketing the target quantile), the
+split-noise calibration identity, and the orchestration plumbing
+(summary, checkpoint carry, guards)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                           ModelConfig, OptimConfig, RunConfig, ShardConfig)
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.ops.server_opt import clip_by_global_norm, identity_server_optimizer
+from fedtpu.orchestration.loop import build_experiment, run_experiment
+from fedtpu.parallel import make_mesh, client_sharding
+from fedtpu.parallel.round import (build_round_fn,
+                                   effective_delta_noise_multiplier,
+                                   init_federated_state)
+from fedtpu.training.client import make_local_train_step
+
+
+def _setup(clip0=1.0, num_clients=8):
+    x, y = synthetic_income_like(256, 6, 2, seed=0)
+    packed = pack_clients(x, y, ShardConfig(num_clients=num_clients,
+                                            shuffle=False))
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(16, 8)))
+    tx = build_optimizer(OptimConfig())
+    mesh = make_mesh(num_clients=num_clients)
+    server = identity_server_optimizer()
+    state = init_federated_state(jax.random.key(0), mesh, num_clients,
+                                 init_fn, tx, same_init=True,
+                                 server_opt=server,
+                                 adaptive_clip_init=clip0)
+    batch = {k: jax.device_put(v, client_sharding(mesh))
+             for k, v in {"x": packed.x, "y": packed.y,
+                          "mask": packed.mask}.items()}
+    return mesh, apply_fn, tx, server, state, batch
+
+
+def test_one_round_clip_update_matches_oracle():
+    """clip_1 == clip_0 * exp(-lr * (b - quantile)) with b recomputed from
+    norms derived by running the local step independently. The initial
+    clip is placed at the measured norm median so the indicator genuinely
+    splits the cohort (b == 0.5, neither saturated extreme)."""
+    quant, lr_c = 0.5, 0.3
+    mesh, apply_fn, tx, server, state, batch = _setup(clip0=1.0)
+    # Oracle: per-client deltas from one local step on the same start.
+    local = make_local_train_step(apply_fn, tx)
+    trained, _, _ = jax.vmap(local)(state["params"], state["opt_state"],
+                                    batch["x"], batch["y"], batch["mask"])
+    delta = jax.tree.map(lambda t, s: np.asarray(t) - np.asarray(s),
+                         trained, state["params"])
+    _, norms = clip_by_global_norm(
+        jax.tree.map(jax.numpy.asarray, delta), 1.0)
+    srt = np.sort(np.asarray(norms))
+    clip0 = float((srt[3] + srt[4]) / 2)     # midpoint: exactly 4 of 8 below
+    b = float((np.asarray(norms) <= clip0).mean())
+    assert b == 0.5, (b, srt)
+    expected = clip0 * np.exp(-lr_c * (b - quant))
+    # Same key -> identical federation, now with the chosen initial clip.
+    mesh, apply_fn, tx, server, state, batch = _setup(clip0=clip0)
+
+    step = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                          server_opt=server, dp_clip_norm=clip0,
+                          dp_adaptive_clip=True, dp_target_quantile=quant,
+                          dp_clip_lr=lr_c)
+    state, _ = step(state, batch)
+    np.testing.assert_allclose(float(np.asarray(state["dp_clip"])),
+                               expected, rtol=1e-5)
+
+
+@pytest.mark.parametrize("quant", [0.3, 0.7])
+def test_clip_settles_inside_the_norm_distribution(quant):
+    """Long-run equilibrium: from a far-too-large initial clip, the
+    geometric tracker descends into the client-norm distribution and
+    oscillates around the target quantile — the realized under-clip
+    fraction over the tail brackets it."""
+    mesh, apply_fn, tx, server, state, batch = _setup(clip0=10.0)
+    step = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                          server_opt=server, dp_clip_norm=10.0,
+                          dp_adaptive_clip=True, dp_target_quantile=quant,
+                          dp_clip_lr=0.5)
+    clips = []
+    for _ in range(60):
+        state, _ = step(state, batch)
+        clips.append(float(np.asarray(state["dp_clip"])))
+    # Tail behavior: per-round log-steps decode b_t exactly
+    # (b_t = quant - ln(c_t/c_{t-1}) / lr); their tail mean is the realized
+    # under-clip fraction the tracker saw.
+    logs = np.diff(np.log(np.asarray([10.0] + clips)))
+    b_tail = quant - logs[-20:] / 0.5
+    assert 0.0 <= b_tail.mean() <= 1.0
+    assert abs(b_tail.mean() - quant) < 0.35, (quant, b_tail.mean())
+    # And it genuinely left the too-large init region.
+    assert clips[-1] < 1.0
+
+
+def test_effective_delta_noise_multiplier_identity():
+    """z^-2 == z_delta^-2 + (2*z_count)^-2 (Andrew et al.), and the guard
+    for the impossible split."""
+    z, zb = 1.1, 2.0
+    zd = effective_delta_noise_multiplier(z, zb)
+    assert zd > z                        # deltas pay MORE noise than z alone
+    np.testing.assert_allclose(zd ** -2 + (2 * zb) ** -2, z ** -2, rtol=1e-12)
+    with pytest.raises(ValueError, match="exceed"):
+        effective_delta_noise_multiplier(1.0, 0.5)
+
+
+def test_guards():
+    mesh, apply_fn, tx, server, state, batch = _setup()
+    with pytest.raises(ValueError, match="initial clip"):
+        build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                       server_opt=server, dp_adaptive_clip=True)
+    with pytest.raises(ValueError, match="meaningless"):
+        build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                       server_opt=server, dp_clip_norm=1.0,
+                       dp_adaptive_clip=True,
+                       dp_count_noise_multiplier=2.0)
+    with pytest.raises(ValueError, match="dp_adaptive_clip"):
+        build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                       server_opt=server, dp_clip_norm=1.0,
+                       dp_count_noise_multiplier=2.0)
+    # State/round_fn mismatch, both directions.
+    plain = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                           server_opt=server, dp_clip_norm=1.0)
+    with pytest.raises(ValueError, match="freeze"):
+        plain(state, batch)
+    init_fn, _ = build_model(ModelConfig(input_dim=6, hidden_sizes=(16, 8)))
+    state_plain = init_federated_state(jax.random.key(0), mesh, 8, init_fn,
+                                       tx, same_init=True, server_opt=server)
+    adaptive = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                              server_opt=server, dp_clip_norm=1.0,
+                              dp_adaptive_clip=True)
+    with pytest.raises(ValueError, match="adaptive_clip_init"):
+        adaptive(state_plain, batch)
+
+
+def _cfg(ck=None, **fed_kw):
+    fed = dict(rounds=4, weighting="uniform", dp_clip_norm=0.1,
+               dp_adaptive_clip=True, dp_clip_lr=0.4)
+    fed.update(fed_kw)
+    return ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=8, shuffle=False),
+        model=ModelConfig(input_dim=6, hidden_sizes=(16, 8)),
+        fed=FedConfig(**fed),
+        run=RunConfig(rounds_per_step=2,
+                      **({"checkpoint_dir": ck, "checkpoint_every": 2}
+                         if ck else {})),
+    )
+
+
+def test_run_experiment_adaptive_dp_end_to_end(tmp_path):
+    """Noise + adaptive clip through the orchestration loop: the summary
+    reports the accountant's epsilon (charged at the CONFIGURED z) and the
+    final clip; checkpoints carry the clip; resume restores it."""
+    ck = str(tmp_path / "ck")
+    cfg = _cfg(ck=ck, dp_noise_multiplier=1.0,
+               dp_count_noise_multiplier=2.0)
+    res = run_experiment(cfg, verbose=False)
+    summary = res.summary()
+    assert summary["final_dp_clip"] is not None
+    assert summary["final_dp_clip"] != pytest.approx(0.1)   # it moved
+    assert np.isfinite(summary["dp"]["epsilon"])
+    assert summary["dp"]["noise_multiplier"] == 1.0         # configured z
+
+    from fedtpu.orchestration.checkpoint import load_checkpoint
+    exp = build_experiment(cfg)
+    state, _, step_no = load_checkpoint(ck, state_like=exp.state)
+    assert step_no == 4
+    np.testing.assert_allclose(float(np.asarray(state["dp_clip"])),
+                               summary["final_dp_clip"], rtol=1e-6)
+
+    cfg6 = dataclasses.replace(cfg, fed=dataclasses.replace(cfg.fed,
+                                                            rounds=6))
+    res6 = run_experiment(cfg6, verbose=False, resume=True)
+    assert res6.rounds_run == 6
+    assert res6.final_dp_clip is not None
+
+
+def test_model_parallel_adaptive_clip_rejected():
+    cfg = dataclasses.replace(_cfg(), run=RunConfig(model_parallel=2))
+    with pytest.raises(ValueError, match="1-D engine"):
+        build_experiment(cfg)
+
+
+def test_data_size_weighting_uses_count_fraction():
+    """Review r4 regression: under weighting='data_size' the clipped
+    fraction must still be a client-COUNT fraction (a weight denominator
+    would pin b near 0 and grow the clip without bound). Same one-round
+    closed form as the uniform oracle."""
+    quant, lr_c = 0.5, 0.3
+    mesh, apply_fn, tx, server, state, batch = _setup(clip0=1.0)
+    local = make_local_train_step(apply_fn, tx)
+    trained, _, _ = jax.vmap(local)(state["params"], state["opt_state"],
+                                    batch["x"], batch["y"], batch["mask"])
+    delta = jax.tree.map(lambda t, s: np.asarray(t) - np.asarray(s),
+                         trained, state["params"])
+    _, norms = clip_by_global_norm(
+        jax.tree.map(jax.numpy.asarray, delta), 1.0)
+    srt = np.sort(np.asarray(norms))
+    clip0 = float((srt[3] + srt[4]) / 2)
+    expected = clip0 * np.exp(-lr_c * (0.5 - quant))   # == clip0 here
+    mesh, apply_fn, tx, server, state, batch = _setup(clip0=clip0)
+    step = build_round_fn(mesh, apply_fn, tx, 2, weighting="data_size",
+                          server_opt=server, dp_clip_norm=clip0,
+                          dp_adaptive_clip=True, dp_target_quantile=quant,
+                          dp_clip_lr=lr_c)
+    state, _ = step(state, batch)
+    np.testing.assert_allclose(float(np.asarray(state["dp_clip"])),
+                               expected, rtol=1e-5)
