@@ -62,11 +62,15 @@ def effective_delta_noise_multiplier(z: float, z_count: float) -> float:
     release BOTH the noised mean delta and the noised clipped-fraction with
     a total privacy cost equal to a single Gaussian mechanism of noise
     multiplier ``z``, the delta noise runs at
-    ``z_delta = (z^-2 - (2*z_count)^-2)^-1/2`` while the unit-sensitivity
-    count sum takes ``z_count``. Requires ``z_count > z/2`` (else the count
-    mechanism alone exceeds the budget). The RDP accountant keeps charging
-    the configured ``z`` — the composition theorem is exactly this
-    identity: z^-2 == z_delta^-2 + (2*z_count)^-2."""
+    ``z_delta = (z^-2 - (2*z_count)^-2)^-1/2`` while the count release —
+    the RECENTERED sum ``sum_i(indicator_i - 1/2)``, add/remove
+    sensitivity 1/2 — takes absolute noise std ``z_count``, i.e. an
+    effective noise multiplier of ``2*z_count`` (the recentering is what
+    earns the factor 2; the round body implements exactly that release).
+    Requires ``z_count > z/2`` (else the count mechanism alone exceeds
+    the budget). The RDP accountant keeps charging the configured ``z`` —
+    the composition theorem is exactly this identity:
+    z^-2 == z_delta^-2 + (2*z_count)^-2."""
     if z_count <= z / 2:
         raise ValueError(
             f"dp_count_noise_multiplier must exceed dp_noise_multiplier/2 "
@@ -606,15 +610,25 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     denom_b = (participation_rate * cb * n_devices
                                if dp_fixed_denom
                                else jnp.maximum(count, 1.0))
+                    # The released quantity is the RECENTERED sum
+                    # sum_i(indicator_i - 1/2) — add/remove sensitivity
+                    # 1/2, which is what justifies crediting the count
+                    # noise as a 2*z_count multiplier in the split
+                    # identity (Andrew et al.; noising the raw sum would
+                    # be sensitivity 1 and undercharge epsilon — review
+                    # r4). At full participation the estimate below is
+                    # numerically identical to the raw fraction.
                     b_sum = jax.lax.psum(
-                        (present * (dnorms <= clip_t)).sum(), CLIENTS_AXIS)
+                        (present * ((dnorms <= clip_t)
+                                    .astype(jnp.float32) - 0.5)).sum(),
+                        CLIENTS_AXIS)
                     if dp_count_noise_multiplier > 0:
                         count_key = jax.random.fold_in(
                             jax.random.fold_in(jax.random.key(dp_seed),
                                                _DP_COUNT_STREAM), r)
                         b_sum = b_sum + (dp_count_noise_multiplier
                                          * jax.random.normal(count_key))
-                    b = b_sum / denom_b
+                    b = b_sum / denom_b + 0.5
                     dpc = dpc * jnp.exp(
                         -dp_clip_lr * (b - dp_target_quantile))
                 new_step, new_sstate = server_opt.update(mean_delta, sstate)

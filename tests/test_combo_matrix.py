@@ -51,6 +51,12 @@ BASES = {
                weighting="uniform"),
     "int8": dict(compress="int8"),
     "median": dict(robust_aggregation="median", weighting="uniform"),
+    # round-4 knobs
+    "scaffold": dict(scaffold=True, weighting="uniform",
+                     server_opt="fedavgm"),
+    "adaptive": dict(dp_clip_norm=1.0, dp_noise_multiplier=0.1,
+                     dp_adaptive_clip=True, dp_count_noise_multiplier=0.5,
+                     weighting="uniform"),
 }
 MODIFIERS = {
     "none": {},
@@ -69,6 +75,8 @@ MODIFIERS = {
 # attack/defense pairing).
 EXPECT_RAISE = {
     ("median", "sample"),      # robust needs full participation
+    ("scaffold", "sample"),    # scaffold needs full participation
+    ("scaffold", "byz"),       # variate/poison attack model incoherent
 }
 
 
@@ -103,7 +111,10 @@ def test_combo_round_executes_or_raises_cleanly(base, mod):
     state = init_federated_state(
         jax.random.key(0), mesh, NUM_CLIENTS, init_fn, tx, same_init=True,
         server_opt=state_server,
-        shared_start=kw.get("compress", "none") != "none")
+        shared_start=kw.get("compress", "none") != "none",
+        scaffold=kw.get("scaffold", False),
+        adaptive_clip_init=(kw["dp_clip_norm"]
+                            if kw.get("dp_adaptive_clip") else None))
 
     step = build_round_fn(mesh, apply_fn, tx, 2, server_opt=server, **kw)
     state, metrics = step(state, batch)
