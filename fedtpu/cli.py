@@ -80,7 +80,7 @@ def _add_common_overrides(p: argparse.ArgumentParser):
     p.add_argument("--scaffold", action="store_true", default=None,
                    help="SCAFFOLD control-variate drift correction "
                         "(Karimireddy et al. 2020; needs --weighting "
-                        "uniform, full participation)")
+                        "uniform)")
     p.add_argument("--participation-rate", type=_participation_rate,
                    default=None,
                    help="per-round client sampling probability in (0, 1] "

@@ -155,10 +155,11 @@ class FedConfig:
     # CANCELLING client drift instead of damping it like prox_mu — the
     # stronger fix for many local steps on non-IID shards. Variate refresh
     # is option I (gradient at the round-start global), exact under any
-    # local optimizer. Requires weighting='uniform', full participation,
-    # aggregation='psum', the 1-D engine; composes with local_steps,
-    # prox_mu, and the FedOpt server optimizers; not with DP (the variates
-    # would be an unaccounted release), compress, or robust rules.
+    # local optimizer. Requires weighting='uniform', aggregation='psum',
+    # the 1-D engine; composes with local_steps, prox_mu, client sampling
+    # (absentees keep stale variates — the paper's |S|/N rule), and the
+    # FedOpt server optimizers; not with DP (the variates would be an
+    # unaccounted release), compress, or robust rules.
     scaffold: bool = False
     # Server-side optimizer over the weighted mean of client DELTAS (FedOpt
     # family, fedtpu.ops.server_opt): 'none' (parameter averaging — the

@@ -310,10 +310,12 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     under ANY local optimizer (option II's ``(x - y_i)/(K*lr)`` closed
     form assumes plain SGD steps). Runs on the delta path (plain identity
     server update == the paper's eta_g=1; composes with FedOpt server
-    optimizers), full participation, uniform weighting, psum aggregation;
-    state must come from ``init_federated_state(..., scaffold=True)``.
-    The new-state invariant ``server_cv == mean_i(client_cv_i)`` holds
-    inductively from the zero init and is test-pinned.
+    optimizers and with client sampling — absentees keep stale variates
+    and contribute zero to the server-variate mean, the paper's
+    (|S|/N)-scaled rule), uniform weighting, psum aggregation; state must
+    come from ``init_federated_state(..., scaffold=True)``. The
+    new-state invariant ``server_cv == mean_i(client_cv_i)`` holds
+    inductively from the zero init, sampled or not, and is test-pinned.
     """
 
     local_train = make_local_train_step(apply_fn, tx, local_steps=local_steps,
@@ -335,12 +337,6 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
         raise ValueError("dp_noise_multiplier requires dp_clip_norm > 0 "
                          "(noise std is noise_multiplier * clip / weight)")
     if scaffold:
-        if sampling:
-            # Partial-participation SCAFFOLD needs the |S|/N-scaled server
-            # variate update and stale-variate handling — not implemented;
-            # fail rather than silently run the full-participation rule.
-            raise ValueError("scaffold requires full participation "
-                             "(participation_rate=1.0)")
         if weighting != "uniform":
             raise ValueError("scaffold is defined over the uniform client "
                              "mean (Karimireddy et al. 2020) — set "
@@ -485,6 +481,23 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
         def one_round(carry, _):
             params, opt_state, sstate, ccv, scv, dpc, r = carry
             start = params           # delta path: every slot holds the server model
+
+            def per_client_where(cond, a, b):
+                # (Cb,) mask broadcast over each leaf's trailing dims.
+                return jnp.where(cond.reshape((cb,) + (1,) * (a.ndim - 1)),
+                                 a, b)
+
+            if sampling:
+                # Per-(round, client) Bernoulli draw, deterministic in the
+                # seed — the in-graph analogue of server-side client
+                # sampling. Drawn BEFORE local work so the SCAFFOLD variate
+                # refresh below can respect it.
+                round_key = jax.random.fold_in(
+                    jax.random.key(participation_seed), r)
+                u = jax.vmap(
+                    lambda i: jax.random.uniform(
+                        jax.random.fold_in(round_key, i)))(gidx)
+                part = (u < participation_rate).astype(jnp.float32)
             if scaffold:
                 # Correction c - c_i enters every local gradient; variates
                 # then refresh from the gradient at the shared round start.
@@ -500,33 +513,29 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     return (jax.lax.psum(d.astype(jnp.float32).sum(axis=0),
                                          CLIENTS_AXIS) / num_clients)
 
-                # c+ = c + mean_i(c_i+ - c_i); with the zero init this keeps
-                # c == mean_i(c_i) inductively (full participation).
+                # Participants refresh to c_i+ = grad_i(x); absentees keep
+                # their (stale) variate — the paper's sampled rule.
+                new_ccv = jax.tree.map(lambda n, o: n.astype(o.dtype),
+                                       ci_plus, ccv)
+                if sampling:
+                    new_ccv = jax.tree.map(
+                        lambda n, o: per_client_where(part > 0, n, o),
+                        new_ccv, ccv)
+                # c+ = c + mean over ALL clients of (c_i+ - c_i) (absentees
+                # contribute zero — this IS the paper's (|S|/N)-scaled
+                # participant mean); with the zero init this keeps
+                # c == mean_i(c_i) inductively, sampled or not.
                 scv = jax.tree.map(
                     lambda s, dm: (s + dm).astype(s.dtype), scv,
                     jax.tree.map(cv_mean,
                                  jax.tree.map(lambda a, b: a - b,
-                                              ci_plus, ccv)))
-                ccv = jax.tree.map(lambda n, o: n.astype(o.dtype),
-                                   ci_plus, ccv)
+                                              new_ccv, ccv)))
+                ccv = new_ccv
             else:
                 trained, new_opt, loss = jax.vmap(local_train)(
                     params, opt_state, x, y, mask)
 
-            def per_client_where(cond, a, b):
-                # (Cb,) mask broadcast over each leaf's trailing dims.
-                return jnp.where(cond.reshape((cb,) + (1,) * (a.ndim - 1)),
-                                 a, b)
-
             if sampling:
-                # Per-(round, client) Bernoulli draw, deterministic in the
-                # seed — the in-graph analogue of server-side client sampling.
-                round_key = jax.random.fold_in(
-                    jax.random.key(participation_seed), r)
-                u = jax.vmap(
-                    lambda i: jax.random.uniform(
-                        jax.random.fold_in(round_key, i)))(gidx)
-                part = (u < participation_rate).astype(jnp.float32)
                 select = lambda a, b: per_client_where(part > 0, a, b)
                 params = jax.tree.map(select, trained, params)
                 opt_state = jax.tree.map(

@@ -75,7 +75,6 @@ MODIFIERS = {
 # attack/defense pairing).
 EXPECT_RAISE = {
     ("median", "sample"),      # robust needs full participation
-    ("scaffold", "sample"),    # scaffold needs full participation
     ("scaffold", "byz"),       # variate/poison attack model incoherent
 }
 

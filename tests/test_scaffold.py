@@ -169,8 +169,6 @@ def test_scaffold_lowers_the_drift_floor():
 def test_incompatible_combos_raise():
     mesh, apply_fn, tx, server, _, _ = _setup(True)
     base = dict(weighting="uniform", server_opt=server, scaffold=True)
-    with pytest.raises(ValueError, match="full participation"):
-        build_round_fn(mesh, apply_fn, tx, 2, participation_rate=0.5, **base)
     with pytest.raises(ValueError, match="uniform"):
         build_round_fn(mesh, apply_fn, tx, 2, server_opt=server,
                        scaffold=True, weighting="data_size")
@@ -285,3 +283,52 @@ def test_scaffold_bf16_params_supported():
                         lambda s: np.asarray(s, np.float32),
                         state["server_cv"]))):
         np.testing.assert_allclose(a, b, atol=2e-2)
+
+
+def test_sampled_scaffold_invariant_and_stale_variates():
+    """Client sampling (paper's partial-participation rule): absentees
+    keep their stale variates and contribute zero to the server-variate
+    mean, so c == mean_i(c_i) keeps holding; after one sampled round some
+    clients' variates must be refreshed and some still zero."""
+    args = _setup(True)
+    mesh, apply_fn, tx, server, state, batch = args
+    step = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                          server_opt=server, scaffold=True,
+                          participation_rate=0.5, participation_seed=7,
+                          local_steps=2)
+    state, _ = step(state, batch)
+    norms1 = np.array([
+        float(np.sqrt(sum(np.sum(np.square(np.asarray(l)[c]))
+                          for l in jax.tree.leaves(state["client_cv"]))))
+        for c in range(8)])
+    assert (norms1 > 1e-8).any(), "no client refreshed its variate"
+    assert (norms1 < 1e-12).any(), "no absentee kept the stale (zero) variate"
+    # Invariant across several more sampled rounds.
+    for _ in range(4):
+        state, _ = step(state, batch)
+    mean_ccv = jax.tree.map(lambda c: np.asarray(c).mean(axis=0),
+                            state["client_cv"])
+    for a, b in zip(jax.tree.leaves(mean_ccv),
+                    jax.tree.leaves(jax.tree.map(np.asarray,
+                                                 state["server_cv"]))):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_sampled_path_with_all_participants_matches_full():
+    """participation_rate=0.999 (the sampled code path) with a seed where
+    every draw lands below it must reproduce the full-participation
+    scaffold exactly — the where-select and |S|/N-mean reduce to the
+    dense rule when S == all."""
+    outs = {}
+    for rate in (1.0, 0.999):
+        args = _setup(True)
+        mesh, apply_fn, tx, server, state, batch = args
+        kw = {} if rate == 1.0 else dict(participation_rate=rate,
+                                         participation_seed=0)
+        step = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                              server_opt=server, scaffold=True,
+                              local_steps=2, rounds_per_step=5, **kw)
+        state, _ = step(state, batch)
+        outs[rate] = jax.tree.map(np.asarray, state["params"])
+    for a, b in zip(jax.tree.leaves(outs[1.0]), jax.tree.leaves(outs[0.999])):
+        np.testing.assert_allclose(a, b, atol=1e-7)
