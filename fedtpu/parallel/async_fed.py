@@ -1,0 +1,222 @@
+"""Asynchronous federated aggregation (FedBuff-style), simulated in-graph.
+
+The reference — and fedtpu's synchronous engines — advance in lockstep
+rounds: every client trains from the same global and the server waits for
+all of them (the MPI barrier structure of FL_CustomMLP...:142,201 IS that
+lockstep). Real federations are asynchronous: clients pull the global at
+different times, train against STALE versions, and the server folds in
+updates as they arrive (FedAsync, Xie et al. 2019; FedBuff, Nguyen et al.
+2022). This module simulates that regime deterministically inside one
+jit-compiled scan, so staleness effects are studyable on-TPU without a
+wall-clock event loop:
+
+- every client carries an ANCHOR — the global version it last pulled —
+  and the server tick it pulled at;
+- each server tick, a Bernoulli(arrival_rate) draw marks which clients
+  COMPLETE this tick (the in-graph stand-in for heterogeneous client
+  speed); completing clients train ``local_steps`` full-batch steps from
+  their anchor and ship ``delta_i = trained_i - anchor_i`` with staleness
+  ``s_i = tick - pull_tick_i``;
+- the server applies the arrival-mean of deltas, each discounted by
+  ``1 / sqrt(1 + s_i)`` (FedBuff's staleness weight; ``staleness_power=0``
+  disables discounting), scaled by ``server_lr``;
+- completing clients re-pull: anchor <- the new global, pull_tick <- tick.
+  Clients that did not complete keep their anchor — their eventual update
+  grows STALER, which is exactly the dynamic under study.
+
+Degenerate-case contract (test-pinned): ``arrival_rate=1`` with
+``staleness_power=0`` and ``server_lr=1`` is EXACTLY the synchronous
+uniform delta path — every client pulls every tick, staleness is
+identically zero, and the arrival mean is the plain client mean.
+
+State layout mirrors the synchronous engines: per-client params/opt_state
+/anchors sharded over the ``('clients',)`` mesh axis, the global derived
+on the fly (anchors of just-pulled clients), pull ticks a small per-client
+int vector. The whole tick — train, discounted aggregation, re-pull — is
+one ``lax.scan`` body under ``shard_map``, ``ticks_per_step`` ticks per
+compiled call, donated state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from fedtpu.parallel.mesh import CLIENTS_AXIS, client_sharding
+from fedtpu.parallel.round import (assemble_metrics, bcast_global,
+                                   client_init_keys)
+from fedtpu.training.client import (make_local_eval_step,
+                                    make_local_train_step)
+
+
+def init_async_state(key: jax.Array, mesh, num_clients: int,
+                     init_fn: Callable, tx: optax.GradientTransformation,
+                     same_init: bool = True) -> dict:
+    """Per-client state + anchors. Every client starts having just pulled
+    the shared initial global (the uniform mean of the inits), tick 0."""
+    params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
+    g0 = jax.tree.map(lambda p: p.mean(axis=0), params)
+    anchors = jax.tree.map(
+        lambda g, p: jnp.broadcast_to(g[None], p.shape).astype(p.dtype),
+        g0, params)
+    shard = client_sharding(mesh)
+    put = lambda t: jax.device_put(t, shard)
+    return {
+        "params": jax.tree.map(put, anchors),      # last trained local model
+        "anchors": jax.tree.map(put, anchors),     # pulled global per client
+        "opt_state": jax.tree.map(put, jax.vmap(tx.init)(anchors)),
+        "pull_tick": put(jnp.zeros((num_clients,), jnp.int32)),
+        "round": jnp.zeros((), jnp.int32),         # server tick counter
+    }
+
+
+def build_async_round_fn(mesh, apply_fn: Callable,
+                         tx: optax.GradientTransformation, num_classes: int,
+                         arrival_rate: float = 0.5,
+                         arrival_seed: int = 0,
+                         staleness_power: float = 0.5,
+                         server_lr: float = 1.0,
+                         local_steps: int = 1,
+                         ticks_per_step: int = 1) -> Callable:
+    """Compile the async server tick. Returns ``step(state, batch) ->
+    (state, metrics)`` over client-sharded batches, like the synchronous
+    engines; ``metrics`` additionally carries ``staleness`` — the (R, C)
+    per-client staleness at each tick (absentees report their CURRENT
+    age, arrivals the staleness their shipped update had).
+
+    ``staleness_power`` p: arrival i is discounted ``(1 + s_i)^-p``
+    (p=0.5 is FedBuff's ``1/sqrt(1+s)``; p=0 disables discounting).
+    DONATES the input state — rebind, clone to keep."""
+    if not 0.0 < arrival_rate <= 1.0:
+        raise ValueError(f"arrival_rate must be in (0, 1], got "
+                         f"{arrival_rate}")
+    if staleness_power < 0:
+        raise ValueError(f"staleness_power must be >= 0, got "
+                         f"{staleness_power}")
+    if server_lr <= 0:
+        raise ValueError(f"server_lr must be > 0, got {server_lr}")
+    local_train = make_local_train_step(apply_fn, tx,
+                                        local_steps=local_steps)
+    local_eval = make_local_eval_step(apply_fn, num_classes)
+    n_devices = mesh.devices.size
+
+    def tick_body(params, opt_state, anchors, pull, x, y, mask, rnd):
+        cb = x.shape[0]
+        gidx = jax.lax.axis_index(CLIENTS_AXIS) * cb + jnp.arange(cb)
+
+        def scan_tick(carry, _):
+            params, opt_state, anchors, pull, g, r = carry
+
+            def per_client(cond, a, b):
+                return jnp.where(cond.reshape((cb,) + (1,) * (a.ndim - 1)),
+                                 a, b)
+
+            if arrival_rate < 1.0:
+                tick_key = jax.random.fold_in(
+                    jax.random.key(arrival_seed), r)
+                u = jax.vmap(lambda i: jax.random.uniform(
+                    jax.random.fold_in(tick_key, i)))(gidx)
+                arrive = (u < arrival_rate).astype(jnp.float32)
+            else:
+                arrive = jnp.ones((cb,), jnp.float32)
+
+            trained, new_opt, loss = jax.vmap(local_train)(
+                anchors, opt_state, x, y, mask)
+            params = jax.tree.map(partial(per_client, arrive > 0),
+                                  trained, params)
+            opt_state = jax.tree.map(
+                lambda a, b: (per_client(arrive > 0, a, b)
+                              if getattr(a, "ndim", 0) >= 1
+                              and a.shape[:1] == (cb,) else a),
+                new_opt, opt_state)
+
+            stale = (r - pull).astype(jnp.float32)
+            disc = arrive * (1.0 + stale) ** -staleness_power
+            n_arrived = jax.lax.psum(arrive.sum(), CLIENTS_AXIS)
+
+            def agg(tr, an):
+                delta = tr.astype(jnp.float32) - an.astype(jnp.float32)
+                local = jnp.tensordot(disc, delta, axes=1)
+                return (jax.lax.psum(local, CLIENTS_AXIS)
+                        / jnp.maximum(n_arrived, 1.0))
+
+            mean_delta = jax.tree.map(agg, trained, anchors)
+            g = jax.tree.map(
+                lambda gl, md: jnp.where(
+                    n_arrived > 0,
+                    gl + server_lr * md.astype(gl.dtype), gl),
+                g, mean_delta)
+            # Arrivals re-pull the fresh global; absentees keep aging.
+            anchors = jax.tree.map(
+                lambda gl, an: per_client(arrive > 0, bcast_global(gl, an),
+                                          an),
+                g, anchors)
+            pull = jnp.where(arrive > 0, r + 1, pull)
+
+            conf = jax.vmap(local_eval)(params, x, y, mask)
+            pooled = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)
+            report_stale = jnp.where(arrive > 0, stale,
+                                     (r - pull).astype(jnp.float32))
+            return (params, opt_state, anchors, pull, g, r + 1), (
+                loss, conf, pooled, report_stale)
+
+        # The current global, reconstructed once per compiled call from
+        # the FRESHEST anchor: arrivals re-pull the new global right after
+        # every server update, so the max-pull slot always holds it (slot
+        # 0 at init, where every client pulled the shared g0 at tick 0).
+        pulls_all = jax.lax.all_gather(pull, CLIENTS_AXIS).reshape(-1)
+        freshest = jnp.argmax(pulls_all)
+
+        def pick_freshest(an):
+            alla = jax.lax.all_gather(an, CLIENTS_AXIS)
+            alla = alla.reshape((-1,) + alla.shape[2:])
+            return jax.lax.dynamic_index_in_dim(alla, freshest,
+                                                keepdims=False)
+
+        g0 = jax.tree.map(pick_freshest, anchors)
+        (params, opt_state, anchors, pull, _, _), stacked = jax.lax.scan(
+            scan_tick, (params, opt_state, anchors, pull, g0, rnd),
+            length=ticks_per_step)
+        loss, conf, pooled, stale = stacked
+        return params, opt_state, anchors, pull, loss, conf, pooled, stale
+
+    spec_c = P(CLIENTS_AXIS)
+    spec_rc = P(None, CLIENTS_AXIS)
+    sharded = jax.shard_map(
+        tick_body, mesh=mesh,
+        in_specs=(spec_c, spec_c, spec_c, spec_c, spec_c, spec_c, spec_c,
+                  P()),
+        out_specs=(spec_c, spec_c, spec_c, spec_c, spec_rc, spec_rc, P(),
+                   spec_rc),
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, batch):
+        (params, opt_state, anchors, pull, loss, conf, pooled,
+         stale) = sharded(state["params"], state["opt_state"],
+                          state["anchors"], state["pull_tick"],
+                          batch["x"], batch["y"], batch["mask"],
+                          state["round"])
+        metrics = assemble_metrics(loss, conf, pooled, batch["mask"],
+                                   ticks_per_step)
+        metrics["staleness"] = (stale if ticks_per_step > 1 else stale[0])
+        new_state = {"params": params, "opt_state": opt_state,
+                     "anchors": anchors, "pull_tick": pull,
+                     "round": state["round"] + ticks_per_step}
+        return new_state, metrics
+
+    return step
+
+
+def async_global_params(state):
+    """The freshest global: the anchor of the most recently pulled client
+    (host-side; use for evaluation after stepping)."""
+    import numpy as np
+    pulls = np.asarray(state["pull_tick"])
+    idx = int(pulls.argmax())
+    return jax.tree.map(lambda a: a[idx], state["anchors"])

@@ -145,6 +145,8 @@ QUICK_TESTS = {
     "test_scaffold.py::test_incompatible_combos_raise",
     "test_adaptive_clip.py::test_effective_delta_noise_multiplier_identity",
     "test_adaptive_clip.py::test_one_round_clip_update_matches_oracle",
+    "test_async.py::test_guards",
+    "test_async.py::test_staleness_bookkeeping_under_sampling",
     # test_multihost_e2e spawns 2 OS processes (~70 s for the round-kernel
     # worker since the int8/Byzantine sections joined) and stays full-tier
     # only; fedtpu/parallel/multihost.py is covered above in-process.

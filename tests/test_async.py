@@ -1,0 +1,126 @@
+"""Asynchronous (FedBuff-style) engine — fedtpu.parallel.async_fed.
+
+The load-bearing pin is the degenerate-case contract: arrival_rate=1 +
+staleness_power=0 + server_lr=1 must reproduce the SYNCHRONOUS uniform
+delta path exactly — same local training, same mean, same global. The
+async machinery (anchors, pull ticks, discounting) then only has to be
+right about what it ADDS, which the staleness and discount pins cover.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.ops.server_opt import identity_server_optimizer
+from fedtpu.parallel import async_fed, client_sharding, make_mesh
+from fedtpu.parallel.round import (build_round_fn, global_params,
+                                   init_federated_state)
+
+C = 8
+
+
+def _fixtures(hidden=(16, 8)):
+    x, y = synthetic_income_like(256, 6, 2, seed=0)
+    packed = pack_clients(x, y, ShardConfig(num_clients=C, shuffle=False))
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=hidden))
+    tx = build_optimizer(OptimConfig())
+    mesh = make_mesh(num_clients=C)
+    batch = {k: jax.device_put(v, client_sharding(mesh)) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    return mesh, init_fn, apply_fn, tx, batch
+
+
+def test_rate1_no_discount_equals_synchronous_delta_path():
+    mesh, init_fn, apply_fn, tx, batch = _fixtures()
+    # Async, everyone arrives every tick, no discounting.
+    # same_init=False on BOTH sides: the starting global is the uniform
+    # mean of per-client inits, exactly the sync delta path's shared start.
+    a_state = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                         init_fn, tx, same_init=False)
+    a_step = async_fed.build_async_round_fn(
+        mesh, apply_fn, tx, 2, arrival_rate=1.0, staleness_power=0.0,
+        server_lr=1.0, ticks_per_step=7)
+    a_state, a_metrics = a_step(a_state, batch)
+    assert np.all(np.asarray(a_metrics["staleness"]) == 0.0)
+
+    # Synchronous uniform delta path from the same init.
+    server = identity_server_optimizer()
+    s_state = init_federated_state(jax.random.key(0), mesh, C, init_fn, tx,
+                                   same_init=False, server_opt=server)
+    s_step = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                            server_opt=server, rounds_per_step=7)
+    s_state, _ = s_step(s_state, batch)
+
+    a_g = jax.tree.map(np.asarray, async_fed.async_global_params(a_state))
+    s_g = jax.tree.map(np.asarray, global_params(s_state))
+    for a, b in zip(jax.tree.leaves(a_g), jax.tree.leaves(s_g)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_staleness_bookkeeping_under_sampling():
+    mesh, init_fn, apply_fn, tx, batch = _fixtures()
+    state = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                       init_fn, tx)
+    step = async_fed.build_async_round_fn(
+        mesh, apply_fn, tx, 2, arrival_rate=0.4, arrival_seed=1,
+        ticks_per_step=10)
+    state, metrics = step(state, batch)
+    stale = np.asarray(metrics["staleness"])          # (10, C)
+    assert stale.shape == (10, C)
+    assert (stale >= 0).all()
+    # Sparse arrivals must produce genuinely stale updates somewhere.
+    assert stale.max() >= 2, stale
+    # Every pull tick is in the past (<= total ticks run).
+    pulls = np.asarray(state["pull_tick"])
+    assert (pulls <= 10).all() and (pulls >= 0).all()
+    # At least one client arrived (pulled after tick 0).
+    assert pulls.max() > 0
+
+
+def test_staleness_discount_changes_the_global():
+    mesh, init_fn, apply_fn, tx, batch = _fixtures()
+    outs = {}
+    for p in (0.0, 0.5):
+        state = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                           init_fn, tx)
+        step = async_fed.build_async_round_fn(
+            mesh, apply_fn, tx, 2, arrival_rate=0.4, arrival_seed=1,
+            staleness_power=p, ticks_per_step=10)
+        state, _ = step(state, batch)
+        outs[p] = jax.tree.map(np.asarray,
+                               async_fed.async_global_params(state))
+    moved = max(float(np.abs(a - b).max())
+                for a, b in zip(jax.tree.leaves(outs[0.0]),
+                                jax.tree.leaves(outs[0.5])))
+    assert moved > 1e-6   # discounting is live exactly when staleness > 0
+
+
+def test_async_training_converges():
+    mesh, init_fn, apply_fn, tx, batch = _fixtures()
+    state = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                       init_fn, tx)
+    step = async_fed.build_async_round_fn(
+        mesh, apply_fn, tx, 2, arrival_rate=0.5, ticks_per_step=20)
+    acc = 0.0
+    for _ in range(5):                                 # 100 ticks
+        state, metrics = step(state, batch)
+        acc = float(np.asarray(metrics["client_mean"]["accuracy"])[-1])
+    assert acc > 0.9, acc
+
+
+def test_guards():
+    mesh, init_fn, apply_fn, tx, _ = _fixtures()
+    with pytest.raises(ValueError, match="arrival_rate"):
+        async_fed.build_async_round_fn(mesh, apply_fn, tx, 2,
+                                       arrival_rate=0.0)
+    with pytest.raises(ValueError, match="staleness_power"):
+        async_fed.build_async_round_fn(mesh, apply_fn, tx, 2,
+                                       staleness_power=-1.0)
+    with pytest.raises(ValueError, match="server_lr"):
+        async_fed.build_async_round_fn(mesh, apply_fn, tx, 2, server_lr=0.0)
