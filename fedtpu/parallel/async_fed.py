@@ -160,8 +160,11 @@ def build_async_round_fn(mesh, apply_fn: Callable,
 
             conf = jax.vmap(local_eval)(params, x, y, mask)
             pooled = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)
-            report_stale = jnp.where(arrive > 0, stale,
-                                     (r - pull).astype(jnp.float32))
+            # Arrivals report the staleness their shipped update had;
+            # absentees their current age — which is the same expression,
+            # because `pull` only moved for arrivals and pre-update
+            # `stale` already equals (r - pull) for everyone else.
+            report_stale = stale
             return (params, opt_state, anchors, pull, g, r + 1), (
                 loss, conf, pooled, report_stale)
 

@@ -638,8 +638,17 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                         b_sum = b_sum + (dp_count_noise_multiplier
                                          * jax.random.normal(count_key))
                     b = b_sum / denom_b + 0.5
-                    dpc = dpc * jnp.exp(
+                    dpc_new = dpc * jnp.exp(
                         -dp_clip_lr * (b - dp_target_quantile))
+                    if dp_count_noise_multiplier == 0:
+                        # Noise-free quantile tracking: a zero-participant
+                        # round observed nothing — b collapses to the 0.5
+                        # prior and would still move the clip by
+                        # exp(-lr*(0.5-q)). Hold the clip instead. (With
+                        # count noise on, the release happens regardless
+                        # and must be consumed as drawn.)
+                        dpc_new = jnp.where(count > 0, dpc_new, dpc)
+                    dpc = dpc_new
                 new_step, new_sstate = server_opt.update(mean_delta, sstate)
                 if sampling and not dp_fixed_denom:
                     # Plain FedOpt under sampling: a zero-participant round

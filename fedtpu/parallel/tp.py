@@ -156,16 +156,31 @@ def init_federated_state_2d(key: jax.Array, mesh: Mesh, num_clients: int,
     out with the client axis dropped — model-sharded like the params."""
     keys = client_init_keys(key, num_clients, same_init)
     pshape = jax.eval_shape(jax.vmap(init_fn), keys)
+    if not isinstance(pshape, dict):
+        # A bare-leaf (or list) params pytree would make opt leaves
+        # "mirror" the params treedef and receive 2-D param shardings —
+        # including scalar step counts, which then fail at jit. Every
+        # tp_specs family is a dict; refuse loudly rather than misplace
+        # silently (advisor r4).
+        raise ValueError(
+            "init_federated_state_2d requires a dict params pytree "
+            "(a tp_specs model family), got "
+            f"{type(pshape).__name__}: optimizer-state placement "
+            "identifies param-mirroring subtrees by treedef")
     specs = tp_specs(pshape)
     pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                           is_leaf=lambda x: isinstance(x, P))
-    # Optax state subtrees that mirror the params treedef (Adam mu/nu) get
-    # the param shardings; everything else (step counts) replicates.
+    # Optax state subtrees that mirror the params treedef (Adam mu/nu) AND
+    # its leaf shapes get the param shardings; everything else (step
+    # counts, bare-leaf lookalikes) replicates.
     ptree = jax.tree.structure(pshape)
+    pleaves_shape = [l.shape for l in jax.tree.leaves(pshape)]
     oshape = jax.eval_shape(jax.vmap(tx.init), pshape)
 
     def place_opt(sub):
-        if jax.tree.structure(sub) == ptree:
+        if (jax.tree.structure(sub) == ptree
+                and [l.shape for l in jax.tree.leaves(sub)]
+                == pleaves_shape):
             return pshard
         return jax.tree.map(lambda _: NamedSharding(mesh, P()), sub)
 

@@ -219,3 +219,32 @@ def test_data_size_weighting_uses_count_fraction():
     state, _ = step(state, batch)
     np.testing.assert_allclose(float(np.asarray(state["dp_clip"])),
                                expected, rtol=1e-5)
+
+
+def test_zero_participant_round_holds_clip_when_noise_free():
+    """Advisor r4 regression: in plain quantile-tracking mode
+    (dp_count_noise_multiplier == 0) a round that samples zero
+    participants observed nothing, so the clip must NOT drift toward the
+    b = 0.5 prior. (With count noise on, the DP release happens
+    regardless and the update must consume it as drawn.)"""
+    mesh, apply_fn, tx, server, state, batch = _setup(clip0=1.0)
+    step = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                          participation_rate=1e-9,
+                          server_opt=server, dp_clip_norm=1.0,
+                          dp_adaptive_clip=True, dp_target_quantile=0.9,
+                          dp_clip_lr=0.3)
+    state, _ = step(state, batch)
+    np.testing.assert_allclose(float(np.asarray(state["dp_clip"])), 1.0,
+                               rtol=0, atol=0)
+    # Same config with count noise on: the release consumes the draw, so
+    # the clip moves even with no participants.
+    mesh, apply_fn, tx, server, state, batch = _setup(clip0=1.0)
+    step_noisy = build_round_fn(mesh, apply_fn, tx, 2, weighting="uniform",
+                                participation_rate=1e-9,
+                                server_opt=server, dp_clip_norm=1.0,
+                                dp_noise_multiplier=0.1,
+                                dp_count_noise_multiplier=0.2,
+                                dp_adaptive_clip=True,
+                                dp_target_quantile=0.9, dp_clip_lr=0.3)
+    state, _ = step_noisy(state, batch)
+    assert float(np.asarray(state["dp_clip"])) != 1.0

@@ -247,3 +247,15 @@ def test_per_device_state_bytes_scale_down_with_tp():
         b = state_bytes(tp.init_federated_state_2d(
             jax.random.key(0), mesh2, 2, init_fn, tx))
         assert base / b > floor, (mp, base, b)
+
+
+def test_bare_leaf_params_rejected():
+    """Advisor r4: a single-leaf params pytree ('*' treedef) would match
+    EVERY optimizer-state subtree in place_opt and assign 2-D param
+    shardings to scalar step counts. The init must refuse it up front."""
+    mesh = tp.make_mesh_2d(2, 8)
+    tx = build_optimizer(OptimConfig())
+    with pytest.raises(ValueError, match="dict params pytree"):
+        tp.init_federated_state_2d(
+            jax.random.key(0), mesh, 8,
+            lambda k: jax.random.normal(k, (6, 4)), tx)
