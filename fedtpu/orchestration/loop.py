@@ -10,9 +10,15 @@ history, log, checkpoint, and time.
 Early-stopping parity (:181-192): rank 0 compares the 4-metric vector
 (accuracy, precision, recall, f1 — mean over clients) against the previous
 round with ``np.allclose(atol=tolerance)``; `patience` consecutive unchanged
-rounds stop training. The reference's stop signal takes effect one round late
-because the loop-top bcast at :132 reads the PREVIOUS round's signal (:195,
-SURVEY.md §5) — fedtpu stops immediately (the lag is a bug, not semantics).
+rounds stop training. The reference's stop SIGNAL is read one loop-top late
+(:132 reads the signal set at :195), but that lag changes NOTHING trained:
+detection at round r happens after round r's train/eval/averaging, and the
+re-entered iteration r+1 breaks before its Barrier/train — so the reference
+trains and averages exactly r rounds, the same count fedtpu stops at. Pinned
+by executing the reference's own ``train_and_evaluate`` under a fake
+single-rank comm (tests/test_stop_lag.py); the only observable residue is
+the second message ("Training stopped early at round N.") printed from the
+doomed iteration, which this loop reproduces for log-faithful A/B.
 
 Throughput knob: ``RunConfig.rounds_per_step = R`` scans R rounds inside one
 compiled program, syncing metrics to host once per R rounds. Early stopping is
@@ -737,6 +743,14 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                                   "change in metrics for "
                                   f"{cfg.fed.termination_patience} rounds.",
                                   flush=True)
+                            if r + 1 < cfg.fed.rounds:
+                                # The reference's break-iteration message
+                                # (FL_CustomMLP...:135): its loop re-enters
+                                # round r+1 (0-indexed == this r+1) and
+                                # breaks before training; printed only when
+                                # there IS a next round to break out of.
+                                print(f"Training stopped early at round "
+                                      f"{r + 1}.", flush=True)
                         stopped_early = True
                         return
                 else:
@@ -752,8 +766,10 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         #     metric divergence guard) fires, one already-in-flight chunk
         #     has trained past the stop; its metrics are DROPPED (history
         #     matches the synchronous run exactly) but the final state
-        #     carries its training. The reference's own stop-signal bcast
-        #     has the same one-step lag (FL_CustomMLP...:132 vs :195).
+        #     carries its training. (The reference's stop-signal bcast is
+        #     also read one loop-top late — :132 vs :195 — but its doomed
+        #     iteration breaks BEFORE training, so unlike this mode the
+        #     reference never trains past the stop; see module docstring.)
         #   * the chunk-end STATE finiteness gate runs only at checkpoint /
         #     held-out-eval boundaries (which sync inherently) and at loop
         #     exit — fetching the in-flight state between ordinary chunks

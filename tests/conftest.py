@@ -49,6 +49,7 @@ QUICK_TESTS = {
     "test_dp_accountant.py::test_monotonicity",
     "test_dp_accountant.py::test_edge_cases",
     "test_sweep.py::test_plateau_stop_freezes_exactly_at_the_plateau_point",
+    "test_stop_lag.py::test_fedtpu_stops_at_the_reference_trained_round_count",
     "test_checkpoint.py::test_latest_step_skips_half_written_rounds",
     "test_checkpoint.py::test_retention_keeps_k_newest_plus_protected",
     "test_combo_matrix.py::"

