@@ -1,0 +1,169 @@
+"""Async (FedBuff-style) engine measured on the chip — VERDICT r4 next #1.
+
+Two questions, answered with the repo's mandatory timing harness
+(fedtpu.utils.timing: fetch-forced windows + flops-floor guard):
+
+1. **Tick cost vs the sync round** at income-8 shapes: the async tick does
+   the same local step plus anchor bookkeeping, arrival draws, and the
+   freshest-anchor gather — what does that machinery cost next to the
+   synchronous uniform delta round it degenerates to at arrival_rate=1?
+
+2. **Accuracy vs arrival rate** on the standing non-IID preset
+   (income-32-noniid): 300 server ticks at arrivals {1.0, 0.5, 0.25} x
+   staleness_power {0, 0.5}, against the 300-round synchronous FedAvg
+   answer. At arrival q, a tick trains ~q*C clients, so 300 ticks do ~q x
+   the local work of 300 sync rounds — the table reports accuracy at equal
+   TICKS (the wall-clock-fair comparison: a tick is a server cadence slot)
+   plus mean/max staleness.
+
+Usage: python benchmarks/async_bench.py [--json OUT.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def bench_tick_cost():
+    import jax
+
+    from fedtpu.config import (DataConfig, ModelConfig, OptimConfig,
+                               ShardConfig)
+    from fedtpu.data import load_dataset
+    from fedtpu.data.sharding import pack_clients
+    from fedtpu.models import build_model
+    from fedtpu.ops import build_optimizer
+    from fedtpu.ops.server_opt import identity_server_optimizer
+    from fedtpu.parallel import async_fed, client_sharding, make_mesh
+    from fedtpu.parallel.round import build_round_fn, init_federated_state
+    from fedtpu.utils.timing import (assert_above_flops_floor,
+                                     compile_with_flops,
+                                     measured_peak_flops, timed_rounds)
+
+    C, RPS = 8, 100
+    ds = load_dataset(DataConfig())
+    mesh = make_mesh(num_clients=C)
+    shard = client_sharding(mesh)
+    packed = pack_clients(ds.x_train, ds.y_train, ShardConfig(num_clients=C))
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=ds.input_dim,
+                                                num_classes=ds.num_classes))
+    tx = build_optimizer(OptimConfig())
+    peak = measured_peak_flops(dtype="float32",
+                               device=mesh.devices.ravel()[0])
+
+    rows = []
+
+    def time_step(label, make_state, make_step):
+        state = make_state()
+        step, flops = compile_with_flops(make_step(), state, batch)
+        samples = []
+        for _ in range(3):
+            sec, state, metrics = timed_rounds(step, state, batch, 10, RPS,
+                                               peak, flops, label=label)
+            samples.append(sec)
+        sec = float(np.median(samples))
+        assert_above_flops_floor(sec, flops, peak, label=label)
+        rows.append({"row": "tick_cost", "label": label, "sec": sec,
+                     "sec_range": [float(min(samples)),
+                                   float(max(samples))],
+                     "flops": flops})
+        print(f"[async_bench] {label}: {sec:.3e} s/tick "
+              f"(band [{min(samples):.3e}, {max(samples):.3e}])",
+              file=sys.stderr)
+
+    server = identity_server_optimizer()
+    time_step(
+        "sync uniform delta round (rps=100)",
+        lambda: init_federated_state(jax.random.key(0), mesh, C, init_fn,
+                                     tx, server_opt=server),
+        lambda: build_round_fn(mesh, apply_fn, tx, ds.num_classes,
+                               weighting="uniform", server_opt=server,
+                               rounds_per_step=RPS))
+    for rate in (1.0, 0.5):
+        time_step(
+            f"async tick (arrival={rate}, tps=100)",
+            lambda: async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                               init_fn, tx),
+            lambda rate=rate: async_fed.build_async_round_fn(
+                mesh, apply_fn, tx, ds.num_classes, arrival_rate=rate,
+                ticks_per_step=RPS))
+    return rows
+
+
+def bench_accuracy_vs_arrival():
+    from fedtpu.config import RunConfig, get_preset
+    from fedtpu.orchestration.loop import run_experiment
+
+    TICKS = 300
+    base = get_preset("income-32-noniid")
+    base = dataclasses.replace(
+        base,
+        fed=dataclasses.replace(base.fed, rounds=TICKS,
+                                weighting="uniform",
+                                termination_patience=10 ** 9),
+        run=RunConfig(rounds_per_step=50, log_every=10 ** 9,
+                      eval_test_every=TICKS))
+    rows = []
+
+    def run(label, **fed_kw):
+        cfg = dataclasses.replace(
+            base, fed=dataclasses.replace(base.fed, **fed_kw))
+        t0 = time.perf_counter()
+        res = run_experiment(cfg, verbose=False)
+        wall = time.perf_counter() - t0
+        row = {"row": "accuracy_vs_arrival", "label": label,
+               "ticks": res.rounds_run,
+               "client_mean_accuracy": res.global_metrics["accuracy"][-1],
+               "pooled_accuracy": res.pooled_metrics["accuracy"][-1],
+               "test_accuracy": res.test_metrics["accuracy"][-1],
+               "wall_s": wall}
+        if res.staleness:
+            row["mean_staleness"] = float(
+                np.mean([s.mean() for s in res.staleness]))
+            row["max_staleness"] = float(
+                max(s.max() for s in res.staleness))
+        rows.append(row)
+        print(f"[async_bench] {label}: client-mean "
+              f"{row['client_mean_accuracy']:.4f}, pooled "
+              f"{row['pooled_accuracy']:.4f}, test "
+              f"{row['test_accuracy']:.4f}"
+              + (f", staleness mean {row['mean_staleness']:.2f} max "
+                 f"{row['max_staleness']:.0f}" if "mean_staleness" in row
+                 else "")
+              + f"  ({wall:.1f}s)", file=sys.stderr)
+
+    run("sync FedAvg 300 rounds (uniform)")
+    for rate in (1.0, 0.5, 0.25):
+        for p in ((0.5,) if rate == 1.0 else (0.5, 0.0)):
+            run(f"async arrival={rate} p={p}", async_mode=True,
+                async_arrival_rate=rate, async_staleness_power=p)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    rows = bench_tick_cost() + bench_accuracy_vs_arrival()
+    out = open(args.json, "w") if args.json else None
+    for r in rows:
+        line = json.dumps(r, default=float)
+        print(line)
+        if out:
+            out.write(line + "\n")
+    if out:
+        out.close()
+
+
+if __name__ == "__main__":
+    main()

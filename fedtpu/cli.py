@@ -258,6 +258,23 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
                                   byzantine_clients=args.byzantine_clients)
     if getattr(args, "init_weights", None) is not None:
         fed = dataclasses.replace(fed, init_weights_npz=args.init_weights)
+    if getattr(args, "async_mode", False):
+        fed = dataclasses.replace(fed, async_mode=True)
+    elif any(getattr(args, a, None) is not None
+             for a in ("arrival_rate", "arrival_seed", "staleness_power")):
+        # Never silently ignore a semantic knob: these only exist under
+        # the async tick process.
+        raise SystemExit("--arrival-rate/--arrival-seed/--staleness-power "
+                         "require --async")
+    if getattr(args, "arrival_rate", None) is not None:
+        fed = dataclasses.replace(fed,
+                                  async_arrival_rate=args.arrival_rate)
+    if getattr(args, "arrival_seed", None) is not None:
+        fed = dataclasses.replace(fed,
+                                  async_arrival_seed=args.arrival_seed)
+    if getattr(args, "staleness_power", None) is not None:
+        fed = dataclasses.replace(
+            fed, async_staleness_power=args.staleness_power)
     run_kw = {}
     if args.checkpoint_dir is not None:
         run_kw["checkpoint_dir"] = args.checkpoint_dir
@@ -320,6 +337,27 @@ def build_parser() -> argparse.ArgumentParser:
     run_p.add_argument("--resume", action="store_true",
                        help="resume from the latest checkpoint in "
                             "--checkpoint-dir")
+    # run-only: asynchronous (FedBuff-style) federation. --rounds counts
+    # server TICKS; composes with --local-steps/--prox-mu/--server-lr;
+    # needs --weighting uniform (the arrival mean is unweighted).
+    run_p.add_argument("--async", dest="async_mode", action="store_true",
+                       help="asynchronous FedBuff-style federation: each "
+                            "tick a Bernoulli(--arrival-rate) subset of "
+                            "clients completes and ships staleness-"
+                            "discounted deltas; --rounds counts ticks "
+                            "(needs --weighting uniform)")
+    run_p.add_argument("--arrival-rate", type=_participation_rate,
+                       default=None,
+                       help="async: per-tick completion probability in "
+                            "(0, 1] (default 0.5)")
+    run_p.add_argument("--arrival-seed", type=int, default=None,
+                       help="async: seed of the deterministic arrival "
+                            "process (default 0)")
+    run_p.add_argument("--staleness-power", type=_nonnegative_float,
+                       default=None,
+                       help="async: arrival deltas are discounted "
+                            "(1+staleness)^-p (default 0.5 = FedBuff's "
+                            "1/sqrt; 0 disables discounting)")
     # run-only, like --aggregation: the sweep/parity programs would accept
     # but silently ignore it.
     run_p.add_argument("--personalize-steps", type=_positive_int,

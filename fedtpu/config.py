@@ -236,9 +236,25 @@ class FedConfig:
     # also applies, the checkpoint restores AFTER (and therefore over) the
     # warm start — resume continues the run, warm start only seeds new ones.
     init_weights_npz: Optional[str] = None
-    # The reference's stop signal takes effect one round late (:132 vs :195,
-    # SURVEY.md §5 'race detection'). fedtpu stops immediately; no flag to
-    # reproduce the lag — it is a bug, not behavior.
+    # Asynchronous (FedBuff-style) federation (fedtpu.parallel.async_fed):
+    # the lockstep round becomes a server TICK — each tick a
+    # Bernoulli(async_arrival_rate) draw marks which clients complete,
+    # completing clients train local_steps from their (possibly stale)
+    # pulled anchor, and the server folds in the staleness-discounted
+    # arrival mean of deltas scaled by server_lr. `rounds` counts ticks;
+    # history/early-stop/checkpoint all run on tick metrics. Requires
+    # weighting='uniform' (the arrival mean is unweighted), the 1-D psum
+    # engine, and composes with local_steps/prox_mu; not with the sync
+    # engine's sampling (arrival IS the sampling process), server_opt,
+    # DP, robust rules, compress, or scaffold.
+    async_mode: bool = False
+    async_arrival_rate: float = 0.5      # P(client completes) per tick
+    async_arrival_seed: int = 0
+    async_staleness_power: float = 0.5   # delta discount (1+s)^-p; 0 = off
+    # The reference reads its stop signal one loop-top late (:132 vs :195)
+    # but the doomed iteration breaks before training — no extra round is
+    # trained, so there is no lag to reproduce (tests/test_stop_lag.py
+    # executes the reference to pin this; SURVEY.md §5 'race detection').
 
 
 @dataclasses.dataclass(frozen=True)

@@ -114,6 +114,10 @@ class ExperimentResult:
     # Final adaptive clip norm (FedConfig.dp_adaptive_clip); None when
     # adaptive clipping is off.
     final_dp_clip: Optional[float] = None
+    # Async engine only (FedConfig.async_mode): per-tick (C,) staleness
+    # vectors — arrivals report the staleness their shipped update had,
+    # absentees their current age. Empty for the synchronous engines.
+    staleness: List[np.ndarray] = dataclasses.field(default_factory=list)
 
     def summary(self) -> dict:
         last = {k: v[-1] for k, v in self.global_metrics.items() if v}
@@ -136,6 +140,11 @@ class ExperimentResult:
             **({"dp": dp} if dp else {}),
             **({"final_dp_clip": self.final_dp_clip}
                if self.final_dp_clip is not None else {}),
+            **({"mean_staleness":
+                float(np.mean([s.mean() for s in self.staleness])),
+                "max_staleness":
+                float(max(s.max() for s in self.staleness))}
+               if self.staleness else {}),
         }
 
     def privacy_spent(self) -> dict:
@@ -206,6 +215,10 @@ class Experiment:
     mesh: object
     # Post-training per-client fine-tune (FedConfig.personalize_steps > 0).
     personalize_fn: Optional[Callable] = None
+    # Extract the global model from the engine's state: slot 0 for the
+    # synchronous engines (every slot holds the post-average global), the
+    # freshest anchor for the async engine (slots hold per-client models).
+    global_fn: Callable = global_params
 
 
 def build_experiment(cfg: ExperimentConfig,
@@ -248,7 +261,61 @@ def build_experiment(cfg: ExperimentConfig,
         from fedtpu.ops.server_opt import identity_server_optimizer
         server = identity_server_optimizer()
 
-    if cfg.run.model_parallel > 1:
+    global_fn = global_params
+    if cfg.fed.async_mode:
+        # The async engine replaces the whole synchronous aggregation
+        # stack with the tick/arrival process — every knob of that stack
+        # is meaningless (or privacy-unsound) under it, so each is
+        # rejected loudly rather than silently ignored.
+        if cfg.run.model_parallel > 1:
+            raise ValueError("async_mode requires the 1-D engine "
+                             "(model_parallel=1)")
+        if cfg.fed.weighting != "uniform":
+            raise ValueError("async_mode requires weighting='uniform': the "
+                             "FedBuff arrival mean is unweighted "
+                             "(--weighting uniform)")
+        if cfg.fed.participation_rate < 1.0:
+            raise ValueError("async_mode replaces client sampling with its "
+                             "own arrival process; use --arrival-rate, not "
+                             "--participation-rate")
+        if server is not None and cfg.fed.server_opt != "none":
+            raise ValueError("async_mode has its own server update "
+                             "(server_lr-scaled discounted delta mean); "
+                             "FedOpt server optimizers are unsupported")
+        if cfg.fed.dp_clip_norm > 0 or cfg.fed.dp_noise_multiplier > 0:
+            raise ValueError("async_mode does not support DP aggregation: "
+                             "per-arrival releases need an async-specific "
+                             "accountant fedtpu does not claim to have")
+        if cfg.fed.robust_aggregation != "none" or cfg.fed.byzantine_clients:
+            raise ValueError("async_mode does not support robust "
+                             "aggregation rules (they need the full cohort "
+                             "each round; arrivals are a sparse subset)")
+        if cfg.fed.compress != "none":
+            raise ValueError("async_mode does not support compressed "
+                             "exchange")
+        if cfg.fed.scaffold:
+            raise ValueError("async_mode does not support SCAFFOLD (its "
+                             "variate refresh assumes lockstep rounds)")
+        if cfg.fed.aggregation != "psum":
+            raise ValueError("async_mode uses the psum aggregation path "
+                             "only")
+        from fedtpu.parallel import async_fed
+        mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
+        shard = client_sharding(mesh)
+        state_fn = lambda: async_fed.init_async_state(
+            jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
+            init_fn, tx, same_init=cfg.fed.same_init)
+        step_fn = lambda r: async_fed.build_async_round_fn(
+            mesh, apply_fn, tx, ds.num_classes,
+            arrival_rate=cfg.fed.async_arrival_rate,
+            arrival_seed=cfg.fed.async_arrival_seed,
+            staleness_power=cfg.fed.async_staleness_power,
+            server_lr=cfg.fed.server_lr,
+            local_steps=cfg.fed.local_steps,
+            prox_mu=cfg.fed.prox_mu,
+            ticks_per_step=r)
+        global_fn = async_fed.async_global_params
+    elif cfg.run.model_parallel > 1:
         # 2-D ('clients','model') GSPMD engine (fedtpu.parallel.tp).
         from fedtpu.parallel import tp
         if model_cfg.kind not in ("mlp", "convnet"):
@@ -362,6 +429,10 @@ def build_experiment(cfg: ExperimentConfig,
                 f"{[tuple(b.shape[1:]) for b in p_leaves]} — the artifact "
                 "was saved for a different hidden_sizes/input_dim")
         state["params"] = _bcast_into_slots(loaded, live)
+        if "anchors" in state:
+            # Async engine: clients "pulled" the warm-start global, so the
+            # anchors (the deltas' reference points) must carry it too.
+            state["anchors"] = _bcast_into_slots(loaded, state["anchors"])
 
     # Opt-in Pallas fused forward for the held-out eval (a plain jit, outside
     # shard_map; the in-round eval stays on the XLA path, which shard_map's
@@ -383,7 +454,7 @@ def build_experiment(cfg: ExperimentConfig,
                                               cfg.fed.personalize_steps)
     return Experiment(make_step=step_fn, state=state, batch=batch,
                       eval_step=eval_step, dataset=ds, mesh=mesh,
-                      personalize_fn=personalize_fn)
+                      personalize_fn=personalize_fn, global_fn=global_fn)
 
 
 @jax.jit
@@ -501,6 +572,21 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     print(f"Resumed from checkpoint at round {start_round}.",
                           flush=True)
             else:
+                if "anchors" in state or "anchors" in raw:
+                    # Async state is NOT post-averaging: slots hold
+                    # distinct per-client models and the global lives in
+                    # the freshest anchor, so the mean-over-slots collapse
+                    # below would resume from a model nobody trained.
+                    # Checked on BOTH sides: the live template (async
+                    # config) and the checkpoint contents (an async-written
+                    # checkpoint resumed under a sync config must not
+                    # silently collapse either).
+                    raise ValueError(
+                        "elastic resume (changed num_clients) is not "
+                        "supported for async-engine state; resume with "
+                        f"the saved client count ({saved_c}) or "
+                        "warm-start a fresh run from exported weights "
+                        "instead")
                 # ELASTIC resume — the cluster grew or shrank (the reference
                 # cannot do this at all: client count is baked into `mpirun
                 # -np N`). Periodic checkpoints hold a post-averaging state,
@@ -555,6 +641,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     pooled_hist = {k: [] for k in METRIC_NAMES}
     per_client_hist = {k: [] for k in METRIC_NAMES}
     test_hist = {k: [] for k in METRIC_NAMES}
+    staleness_hist: List[np.ndarray] = []
     losses: List[np.ndarray] = []
     sec_per_round: List[float] = []
     timer = Timer().start()
@@ -573,7 +660,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         return not bool(_tree_finite(
             {k: state[k] for k in
              ("params", "opt_state", "server_opt_state",
-              "client_cv", "server_cv", "dp_clip") if k in state}))
+              "client_cv", "server_cv", "dp_clip", "anchors")
+             if k in state}))
 
     def halt_diverged(reason: str, label_round: int):
         """Shared divergence halt: quarantine the poisoned state under
@@ -697,6 +785,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     history[k].append(client_mean[k])
                     pooled_hist[k].append(float(m["pooled"][k]))
                     per_client_hist[k].append(per_client[k])
+                if "staleness" in m:        # async engine's extra metric
+                    staleness_hist.append(np.asarray(m["staleness"]))
 
                 if jsonl is not None:
                     jsonl.write(json.dumps({
@@ -704,6 +794,9 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                         "client_mean": client_mean,
                         "pooled": {k: pooled_hist[k][-1] for k in METRIC_NAMES},
                         "loss_mean": float(np.mean(losses[-1])),
+                        **({"staleness_mean":
+                            float(staleness_hist[-1].mean())}
+                           if "staleness" in m else {}),
                     }) + "\n")
                     jsonl.flush()
 
@@ -719,8 +812,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                                   f"[{vals}]", flush=True)
                     gvals = ", ".join(f"{k}: {client_mean[k]:.4f}"
                                       for k in METRIC_NAMES)
+                    stale_note = (f"  (mean staleness "
+                                  f"{staleness_hist[-1].mean():.2f})"
+                                  if "staleness" in m else "")
                     print(f"  Global Metrics (Round {r + 1}): [{gvals}]  "
-                          f"({dt * 1e3:.1f} ms/round)", flush=True)
+                          f"({dt * 1e3:.1f} ms/round){stale_note}",
+                          flush=True)
 
                 # Failure detection: a diverged step (NaN/inf loss or
                 # metrics) halts cleanly instead of burning the remaining
@@ -847,7 +944,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # _rep: the global slice of a client-sharded array is not
                 # host-addressable from every process; replicated params
                 # also make the eval jit's output fetchable everywhere.
-                tm = eval_step(_rep(global_params(state)),
+                tm = eval_step(_rep(exp.global_fn(state)),
                                ds.x_test, ds.y_test)
                 for _ in range(eval_due):
                     for k in METRIC_NAMES:
@@ -933,10 +1030,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         sec_per_round=sec_per_round,
         rounds_run=rounds_run,
         stopped_early=stopped_early,
-        final_params=to_numpy(_rep(global_params(state))),
+        final_params=to_numpy(_rep(exp.global_fn(state))),
         config=cfg,
         diverged=diverged,
         personalized_metrics=personalized,
+        staleness=staleness_hist,
         # The state's own round counter — the exact ledger of what the
         # released params trained through (> rounds_run after a pipelined
         # early stop's overshoot chunk; the DP accountant must count it).

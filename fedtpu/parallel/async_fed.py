@@ -66,9 +66,16 @@ def init_async_state(key: jax.Array, mesh, num_clients: int,
         g0, params)
     shard = client_sharding(mesh)
     put = lambda t: jax.device_put(t, shard)
+    anchors = jax.tree.map(put, anchors)
     return {
-        "params": jax.tree.map(put, anchors),      # last trained local model
-        "anchors": jax.tree.map(put, anchors),     # pulled global per client
+        # params start equal to the anchors but must be INDEPENDENT
+        # buffers: on a single-device mesh device_put of an already-placed
+        # array is a no-op, and aliased params/anchors leaves make the
+        # donating tick fail with "donate the same buffer twice" (found
+        # the first time the engine ran on the real one-chip TPU — every
+        # virtual-mesh test had one client per device).
+        "params": jax.tree.map(jnp.copy, anchors),  # last trained local model
+        "anchors": anchors,                         # pulled global per client
         "opt_state": jax.tree.map(put, jax.vmap(tx.init)(anchors)),
         "pull_tick": put(jnp.zeros((num_clients,), jnp.int32)),
         "round": jnp.zeros((), jnp.int32),         # server tick counter
@@ -82,6 +89,7 @@ def build_async_round_fn(mesh, apply_fn: Callable,
                          staleness_power: float = 0.5,
                          server_lr: float = 1.0,
                          local_steps: int = 1,
+                         prox_mu: float = 0.0,
                          ticks_per_step: int = 1) -> Callable:
     """Compile the async server tick. Returns ``step(state, batch) ->
     (state, metrics)`` over client-sharded batches, like the synchronous
@@ -100,8 +108,12 @@ def build_async_round_fn(mesh, apply_fn: Callable,
                          f"{staleness_power}")
     if server_lr <= 0:
         raise ValueError(f"server_lr must be > 0, got {server_lr}")
+    # prox_mu's anchor is the params the step starts from — which here is
+    # the client's pulled anchor, exactly the FedProx-against-stale-global
+    # regularization FedBuff-style systems pair with many local steps.
     local_train = make_local_train_step(apply_fn, tx,
-                                        local_steps=local_steps)
+                                        local_steps=local_steps,
+                                        prox_mu=prox_mu)
     local_eval = make_local_eval_step(apply_fn, num_classes)
     n_devices = mesh.devices.size
 
@@ -216,10 +228,18 @@ def build_async_round_fn(mesh, apply_fn: Callable,
     return step
 
 
+@jax.jit
+def _freshest_anchor(pull_tick, anchors):
+    idx = jnp.argmax(pull_tick)
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, idx, keepdims=False),
+        anchors)
+
+
 def async_global_params(state):
-    """The freshest global: the anchor of the most recently pulled client
-    (host-side; use for evaluation after stepping)."""
-    import numpy as np
-    pulls = np.asarray(state["pull_tick"])
-    idx = int(pulls.argmax())
-    return jax.tree.map(lambda a: a[idx], state["anchors"])
+    """The freshest global: the anchor of the most recently pulled client.
+    A jitted gather (not a host argmax+index) so it works when the
+    client-sharded leaves are not host-addressable — multi-process meshes
+    (fedtpu.parallel.multihost), where run_experiment evaluates and
+    checkpoints through this exactly like the sync engines' slot 0."""
+    return _freshest_anchor(state["pull_tick"], state["anchors"])
