@@ -49,7 +49,11 @@ NUM_CLIENTS = 8
 def bench_fedtpu(cfg, ds):
     out = {}
     for label, kw in (
+            # r5 default: arch axis stacked into the vmap — 2 launches.
             ("fixed400_bucketed", dict(bucket_pad=True)),
+            # r4 behavior: one launch per architecture (10 launches).
+            ("fixed400_bucketed_per_arch", dict(bucket_pad=True,
+                                                vmap_arch=False)),
             ("fixed400_unbucketed", dict(bucket_pad=False)),
             ("plateau_bucketed", dict(bucket_pad=True, plateau_stop=True)),
     ):
@@ -57,12 +61,16 @@ def bench_fedtpu(cfg, ds):
         best = run_grid_search(cfg, dataset=ds, verbose=False, **kw)
         dt = time.perf_counter() - t0
         out[label] = {"wall_s": dt, "compile_count": best["compile_count"],
+                      "launch_count": best["launch_count"],
                       "best": best["params"],
                       "best_accuracy": best["accuracy"],
+                      "tie_set_size": len(best["tie_set"]),
                       "configs": len(best["table"])}
         print(f"[sweep] fedtpu {label}: {dt:.1f} s, "
-              f"{best['compile_count']} compiles, winner {best['params']} "
-              f"acc {best['accuracy']:.4f}", flush=True)
+              f"{best['compile_count']} compiles / "
+              f"{best['launch_count']} launches, winner {best['params']} "
+              f"acc {best['accuracy']:.4f}, tie set "
+              f"{len(best['tie_set'])}", flush=True)
     # Warm-cache rerun of the production mode: the steady-state sweep time
     # once the jit cache holds the two depth-class programs.
     t0 = time.perf_counter()

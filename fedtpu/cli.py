@@ -380,6 +380,12 @@ def build_parser() -> argparse.ArgumentParser:
                               "weights + hyperparameters + metrics as an "
                               ".npz (the reference only prints them, "
                               "hyperparameters_tuning.py:130-132)")
+    sweep_p.add_argument("--no-vmap-arch", action="store_true",
+                         help="launch one program per architecture instead "
+                              "of stacking each depth class's architectures "
+                              "into the vmapped axis (the default runs the "
+                              "90-config grid as 2 launches; parity-check "
+                              "path)")
     sweep_p.add_argument("--no-bucket-pad", action="store_true",
                          help="compile one program per architecture "
                               "instead of zero-padding each to its depth "
@@ -468,6 +474,7 @@ def main(argv=None) -> int:
                 keep_weights=bool(args.save_weights),
                 plateau_stop=args.plateau_stop,
                 bucket_pad=not args.no_bucket_pad,
+                vmap_arch=not args.no_vmap_arch,
                 verbose=not args.quiet)
             if table_f is not None:
                 for row in summary["table"]:

@@ -32,6 +32,20 @@ fedtpu mapping:
     sklearn's L2 term adds 0 for zero entries — pinned against the
     unpadded path in tests/test_sweep.py. Winner weights are sliced back
     to their true dims before they leave this module.
+  * Launch-count cut (VERDICT r4 #2): since bucket-padded same-depth
+    architectures trace to identical shapes, each depth class's
+    architectures are additionally STACKED into the vmapped lr axis
+    (arch-major), so the whole class runs as ONE program launch — the
+    90-config grid is 2 launches end to end. Parity with the
+    per-architecture path is pinned in tests/test_sweep.py (observed
+    bit-identical; asserted at float-drift tolerance, since the two
+    launch plans are differently-shaped XLA programs).
+  * Winner reporting (VERDICT r4 #3): the strict-`>` first-hit argmax in
+    grid order is kept as the labeled reference-parity answer
+    (hyperparameters_tuning.py:115-119), and the STABLE result — the
+    ``tie_set`` of every config within ``tie_tolerance`` of the top
+    accuracy — rides alongside it, because several configs genuinely tie
+    at 1.0 and ulp drift between compiled programs re-orders the argmax.
 """
 
 from __future__ import annotations
@@ -221,6 +235,8 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     keep_weights: bool = False,
                     plateau_stop: bool = False,
                     bucket_pad: bool = True,
+                    vmap_arch: bool = True,
+                    tie_tolerance: float = 1e-6,
                     verbose: bool = True) -> dict:
     """Run the 90-config federated grid; returns the best-config summary
     (the reference's :126-132 printout, as data). ``hidden_grid``/``lr_grid``
@@ -241,9 +257,24 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
 
     ``bucket_pad=True`` (default) zero-pads every architecture to its
     depth class's max dims so same-depth configs share one compiled
-    program (module docstring; exact math, pinned in tests). The returned
-    dict carries ``compile_count`` either way. ``bucket_pad=False`` is
-    the one-compile-per-architecture path."""
+    program (module docstring; exact math, pinned in tests).
+    ``vmap_arch=True`` (default) goes one step further: since same-depth
+    architectures already trace to identical padded shapes, each depth
+    class's architectures are STACKED into the vmapped lr axis and the
+    whole class runs as ONE launch — the reference's 90 sequential
+    configs (hyperparameters_tuning.py:80-84) become 2 program launches.
+    Requires vmap_lr and bucket_pad (falls back to per-architecture
+    launches otherwise). The returned dict carries ``compile_count`` and
+    ``launch_count`` either way.
+
+    Winner semantics: ``best`` keeps the reference's strict-``>``
+    first-hit argmax in grid order (:115-119) — the labeled parity
+    answer. Because ties are real (several configs hit exactly 1.0 train
+    accuracy on separable data) and ulp-level drift between compiled
+    programs can re-order that argmax, the STABLE result is
+    ``tie_set``: every config within ``tie_tolerance`` of the top
+    accuracy (well below the one-sample accuracy quantum, well above
+    float drift). Each table row carries ``in_tie_set``."""
     hidden_grid = HIDDEN_GRID if hidden_grid is None else hidden_grid
     lr_grid = LR_GRID if lr_grid is None else lr_grid
     ds = dataset or load_dataset(cfg.data)
@@ -255,10 +286,8 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     mask = jax.device_put(packed.mask, shard)
 
     c = cfg.shard.num_clients
-    lrs_all = list(lr_grid) if vmap_lr else [[lr] for lr in lr_grid]
-
-    best = {"accuracy": -1.0, "params": None, "metrics": None, "weights": None}
-    table = []
+    adam = optax.scale_by_adam(b1=cfg.optim.b1, b2=cfg.optim.b2,
+                               eps=cfg.optim.eps, eps_root=0.0)
 
     # ONE jit object for the whole grid (its closure is architecture-free):
     # the jit cache then shares a compiled program between every
@@ -268,70 +297,148 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                                cfg.optim, plateau_stop=plateau_stop,
                                l2_alpha=1e-4 if plateau_stop else 0.0)
 
-    for hidden in hidden_grid:
-        lr_groups = [lrs_all] if vmap_lr else lrs_all
-        bucket = (_bucket_shape(hidden, hidden_grid) if bucket_pad
-                  else tuple(hidden))
-        for lr_group in lr_groups:
-            l = len(lr_group)
+    # ---- launch plan: each launch trains a list of same-bucket
+    # architectures x a list of learning rates in one compiled call, the
+    # (arch, lr) product flattened arch-major into the vmapped slot axis.
+    use_arch_vmap = vmap_arch and vmap_lr and bucket_pad
+    if use_arch_vmap:
+        classes: dict = {}
+        for h in hidden_grid:
+            classes.setdefault(len(h), []).append(h)
+        launches = [(archs, list(lr_grid)) for archs in classes.values()]
+    else:
+        lr_groups = [list(lr_grid)] if vmap_lr else [[lr] for lr in lr_grid]
+        launches = [([h], g) for h in hidden_grid for g in lr_groups]
+
+    # (hidden, lr) -> row dict. Weights are materialized EAGERLY for each
+    # launch's first slot at the launch's max accuracy — the only slot of
+    # that launch the global strict-> winner can be (the winner sits at
+    # the global max, which is its own launch's max, and nothing earlier
+    # in its launch matches it) — so no launch's device output outlives
+    # its iteration (review r5: lazy closures kept every launch's
+    # avg_params resident until return).
+    results: dict = {}
+    for n_launch, (archs, lr_group) in enumerate(launches):
+        l = len(lr_group)
+        bucket = (_bucket_shape(archs[0], hidden_grid) if bucket_pad
+                  else tuple(archs[0]))
+        slabs = []
+        for hidden in archs:
             # Same-seed init per config == fresh random_state=42 model per
-            # config (hyperparameters_tuning.py:90): identical across clients
-            # and learning rates. Padding to the bucket shape happens AFTER
-            # the true-shape init, so padded and unpadded runs train the
-            # exact same effective network.
+            # config (hyperparameters_tuning.py:90): identical across
+            # clients and learning rates. Padding to the bucket shape
+            # happens AFTER the true-shape init, so padded and unpadded
+            # runs train the exact same effective network.
             base_params = mlp_init(jax.random.key(42), ds.input_dim, hidden,
                                    ds.num_classes)
             if bucket != tuple(hidden):
                 base_params = jax.tree.map(
                     jnp.asarray, _pad_params(base_params, ds.input_dim,
-                                             hidden, bucket, ds.num_classes))
-            params = jax.tree.map(
-                lambda p: jnp.broadcast_to(p, (c, l) + p.shape), base_params)
-            opt_state = jax.vmap(jax.vmap(
-                lambda p: optax.scale_by_adam(
-                    b1=cfg.optim.b1, b2=cfg.optim.b2, eps=cfg.optim.eps,
-                    eps_root=0.0).init(p)))(params)
-            params = jax.tree.map(lambda p: jax.device_put(p, shard), params)
-            opt_state = jax.tree.map(lambda p: jax.device_put(p, shard),
-                                     opt_state)
-            lrs = jnp.asarray(lr_group, jnp.float32)
-            avg_params, conf, pooled_conf, mean_steps = sweep_fn(
-                params, opt_state, lrs, x, y, mask)
+                                             hidden, bucket,
+                                             ds.num_classes))
+            slabs.append(base_params)
+        # (A, ...) stack -> (A*L, ...) arch-major repeat -> (c, A*L, ...).
+        stacked = jax.tree.map(lambda *ps: jnp.stack(ps), *slabs)
+        params = jax.tree.map(
+            lambda p: jnp.broadcast_to(
+                jnp.repeat(p, l, axis=0)[None],
+                (c, len(archs) * l) + p.shape[1:]), stacked)
+        opt_state = jax.vmap(jax.vmap(adam.init))(params)
+        params = jax.tree.map(lambda p: jax.device_put(p, shard), params)
+        opt_state = jax.tree.map(lambda p: jax.device_put(p, shard),
+                                 opt_state)
+        lrs = jnp.tile(jnp.asarray(lr_group, jnp.float32), len(archs))
+        avg_params, conf, pooled_conf, mean_steps = sweep_fn(
+            params, opt_state, lrs, x, y, mask)
 
-            pooled = jax.vmap(metrics_from_confusion)(pooled_conf)
-            pooled = {k: np.asarray(v) for k, v in pooled.items()}
-            mean_steps = np.asarray(mean_steps)
-            for i, lr in enumerate(lr_group):
-                metrics = {k: float(v[i]) for k, v in pooled.items()}
-                table.append({"hidden_layer_sizes": tuple(hidden),
-                              "learning_rate": float(lr),
-                              "mean_local_steps": float(mean_steps[i]),
-                              **metrics})
-                if verbose:
-                    print(f"  grid [{hidden} lr={lr}]: "
-                          f"acc={metrics['accuracy']:.4f} "
-                          f"f1={metrics['f1']:.4f}", flush=True)
-                if metrics["accuracy"] > best["accuracy"]:
-                    win = jax.tree.map(lambda p: np.asarray(p[i]),
-                                       avg_params)
+        pooled = jax.vmap(metrics_from_confusion)(pooled_conf)
+        pooled = {k: np.asarray(v) for k, v in pooled.items()}
+        mean_steps = np.asarray(mean_steps)
+        cand = int(np.argmax(pooled["accuracy"]))   # first slot at launch max
+        for a, hidden in enumerate(archs):
+            for j, lr in enumerate(lr_group):
+                i = a * l + j
+                w = None
+                if i == cand:
+                    w = jax.tree.map(lambda p: np.asarray(p[i]), avg_params)
                     if bucket != tuple(hidden):
-                        win = _unpad_params(win, ds.input_dim, hidden,
-                                            ds.num_classes)
-                    best = {
-                        "accuracy": metrics["accuracy"],
-                        "params": {"hidden_layer_sizes": tuple(hidden),
-                                   "learning_rate": float(lr)},
-                        "metrics": metrics,
-                        "weights": win,
-                    }
+                        w = _unpad_params(w, ds.input_dim, hidden,
+                                          ds.num_classes)
+                results[(tuple(hidden), float(lr))] = {
+                    "metrics": {k: float(v[i]) for k, v in pooled.items()},
+                    "mean_local_steps": float(mean_steps[i]),
+                    "win": w,
+                }
+        del avg_params, conf, pooled_conf
+        if verbose:
+            print(f"  launch {n_launch + 1}/{len(launches)} done "
+                  f"({len(archs)} architectures x {l} learning rates)",
+                  flush=True)
+
+    # ---- reporting in REFERENCE grid order (hidden outer, lr inner), so
+    # the first-hit strict-> argmax is launch-plan-independent.
+    best = {"accuracy": -1.0, "params": None, "metrics": None,
+            "weights": None}
+    table = []
+    for hidden in hidden_grid:
+        for lr in lr_grid:
+            row = results[(tuple(hidden), float(lr))]
+            metrics = row["metrics"]
+            table.append({"hidden_layer_sizes": tuple(hidden),
+                          "learning_rate": float(lr),
+                          "mean_local_steps": row["mean_local_steps"],
+                          **metrics})
+            if verbose:
+                print(f"  grid [{hidden} lr={lr}]: "
+                      f"acc={metrics['accuracy']:.4f} "
+                      f"f1={metrics['f1']:.4f}", flush=True)
+            if metrics["accuracy"] > best["accuracy"]:
+                best = {
+                    "accuracy": metrics["accuracy"],
+                    "params": {"hidden_layer_sizes": tuple(hidden),
+                               "learning_rate": float(lr)},
+                    "metrics": metrics,
+                    "weights": None,
+                }
+    # The strict-> scan's final winner is the first grid-order row at the
+    # global max — which is its own launch's first-at-max slot, the one
+    # slot per launch whose weights were materialized above.
+    best["weights"] = results[
+        (tuple(best["params"]["hidden_layer_sizes"]),
+         best["params"]["learning_rate"])]["win"]
+    assert best["weights"] is not None
+
+    # ---- tie set: the stable answer (VERDICT r4 next #3). Strict-> picks
+    # ONE of these depending on ulp drift between compiled programs; the
+    # set itself is invariant to that drift because tie_tolerance sits
+    # well above float noise and well below one sample's accuracy quantum.
+    top = best["accuracy"]
+    tie_set = []
+    for row in table:
+        tied = row["accuracy"] >= top - tie_tolerance
+        row["in_tie_set"] = tied
+        if tied:
+            tie_set.append({"hidden_layer_sizes": row["hidden_layer_sizes"],
+                            "learning_rate": row["learning_rate"],
+                            "accuracy": row["accuracy"]})
 
     if verbose:
         print("\nBest Global Hyperparameters:", best["params"])
         print(f"Best Global Metrics: {best['metrics']}")
+        if len(tie_set) > 1:
+            print(f"Tie set ({len(tie_set)} configs within "
+                  f"{tie_tolerance:g} of accuracy {top:.4f} — the strict-> "
+                  "winner above is one arbitrary member):")
+            for t in tie_set:
+                print(f"  {t['hidden_layer_sizes']} "
+                      f"lr={t['learning_rate']}", flush=True)
     weights = best["weights"] if keep_weights else best.pop("weights")
     best["weight_shapes"] = ([list(lyr["w"].shape) for lyr in weights["layers"]]
                              if weights else [])
     best["table"] = table
+    best["tie_set"] = tie_set
+    best["tie_tolerance"] = tie_tolerance
+    best["launch_count"] = len(launches)
     # Compiled-program accounting (VERDICT r3 #2): with bucket_pad this is
     # the number of depth classes, not architectures.
     try:
