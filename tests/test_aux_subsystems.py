@@ -132,6 +132,80 @@ def test_cifar10_synthetic_fallback_shapes():
     assert ds.num_classes == 10
 
 
+def _fabricate_cifar_batches(d, per_batch=8):
+    """A minimal, REAL-format cifar-10-batches-py: 5 train pickles + one
+    test pickle, bytes keys, uint8 (N, 3072) row-major RGB planes + label
+    lists — exactly what the torchvision/keras-distributed tarball
+    unpacks to and what _load_batch parses."""
+    import pickle
+
+    rng = np.random.default_rng(7)
+    d.mkdir(parents=True, exist_ok=True)
+    planted = {}
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        data = rng.integers(0, 256, size=(per_batch, 3072), dtype=np.uint8)
+        labels = [int(v) for v in rng.integers(0, 10, per_batch)]
+        with open(d / name, "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels,
+                         b"batch_label": b"fabricated"}, f)
+        planted[name] = (data, labels)
+    return planted
+
+
+def test_cifar10_real_batches_branch(tmp_path):
+    """VERDICT r4 weak #6: the REAL-data branch of the config-5 loader,
+    exercised against a fabricated on-disk batch set — load, NHWC
+    transpose, /255 normalization, train concat, test split, flatten."""
+    from fedtpu.data.cifar10 import find_cifar10_dir
+
+    d = tmp_path / "cifar-10-batches-py"
+    planted = _fabricate_cifar_batches(d, per_batch=8)
+    assert find_cifar10_dir(str(d)) == str(d)
+
+    ds = load_cifar10(root=str(d), flatten=False)
+    assert ds.x_train.shape == (40, 32, 32, 3)      # 5 batches x 8
+    assert ds.x_test.shape == (8, 32, 32, 3)
+    assert ds.num_classes == 10
+    # Normalization + CHW->HWC transpose pinned against the raw bytes:
+    # row r of b"data" is 1024 R + 1024 G + 1024 B values, each plane
+    # row-major 32x32 — so pixel (h, w, c) = raw[r, c*1024 + h*32 + w]/255.
+    raw, labels = planted["data_batch_1"]
+    for (r, h, w, c) in ((0, 0, 0, 0), (3, 5, 17, 1), (7, 31, 31, 2)):
+        np.testing.assert_allclose(ds.x_train[r, h, w, c],
+                                   raw[r, c * 1024 + h * 32 + w] / 255.0,
+                                   rtol=1e-6)
+    np.testing.assert_array_equal(ds.y_train[:8], np.asarray(labels))
+    assert ds.x_train.min() >= 0.0 and ds.x_train.max() <= 1.0
+    # The flattened view (what pack_clients consumes) is the same data.
+    ds_flat = load_cifar10(root=str(d), flatten=True)
+    np.testing.assert_array_equal(ds_flat.x_train,
+                                  ds.x_train.reshape(40, -1))
+    # And it shards through the standard packing path.
+    packed = pack_clients(ds_flat.x_train, ds_flat.y_train,
+                          ShardConfig(num_clients=8, shuffle=False))
+    assert packed.x.shape[0] == 8 and packed.x.shape[2] == 3072
+    assert int(packed.mask.sum()) == 40     # every real row exactly once
+
+
+def test_cifar10_real_branch_via_load_dataset(tmp_path):
+    """The dataset_name='cifar10' config path takes the real branch when
+    the batches exist at a candidate location (chdir into tmp)."""
+    import os as _os
+
+    from fedtpu.config import DataConfig
+    from fedtpu.data import load_dataset
+
+    _fabricate_cifar_batches(tmp_path / "cifar-10-batches-py")
+    cwd = _os.getcwd()
+    _os.chdir(tmp_path)
+    try:
+        ds = load_dataset(DataConfig(dataset_name="cifar10"))
+    finally:
+        _os.chdir(cwd)
+    assert ds.x_train.shape == (40, 3072)           # real branch, not synthetic
+    assert ds.num_classes == 10
+
+
 def test_synthetic_cifar_deterministic():
     a, ya = synthetic_cifar_like(32)
     b, yb = synthetic_cifar_like(32)
