@@ -50,6 +50,12 @@ aggregation with its hops crossing the process boundary, and true
 tp-over-DCN — a transposed ('clients','model') mesh whose model-axis
 pairs each span both processes, so the Megatron col/row collectives
 themselves ride the inter-process link.
+
+Round 5 widens the executed matrix: the same kernel worker and the full
+pipelined-checkpoint loop also run at FOUR processes x two devices each
+(every collective crossing three process boundaries), and process-death
+failure propagation is executed, not assumed — see ``initialize``'s
+docstring for the semantics (the ``comm.Abort`` analogue).
 """
 
 from __future__ import annotations
@@ -86,7 +92,7 @@ def _looks_multihost() -> bool:
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None, **kwargs) -> None:
     """Wire this process into the multi-host runtime.
 
     Must run before any other JAX call (jax.distributed's contract — even
@@ -95,12 +101,28 @@ def initialize(coordinator_address: Optional[str] = None,
     Single-process (one host, tests): the failed auto-init is swallowed and
     the program proceeds single-controller. If the environment looks
     multi-host but initialization fails, this RAISES rather than letting
-    every worker silently run its own private federation.
+    every worker silently run its own private federation. Extra ``kwargs``
+    pass through to ``jax.distributed.initialize`` (e.g.
+    ``heartbeat_timeout_seconds``).
+
+    FAILURE PROPAGATION (the reference's ``comm.Abort`` analogue,
+    FL_CustomMLP...:203-205, executed in
+    tests/test_multihost_e2e.py::test_process_death_terminates_survivors):
+    when a process dies mid-run, survivors block in their next
+    cross-process collective, the coordination service detects the missed
+    heartbeats within ``heartbeat_timeout_seconds`` (jax default 100), and
+    every surviving process is TERMINATED with a fatal "distributed
+    service detected fatal errors" diagnostic — no hung ranks, no
+    survivors silently continuing a partial federation. This is stronger
+    than an exception (the runtime cannot guarantee collective state after
+    a peer loss); restart + ``--resume`` from the last periodic checkpoint
+    is the recovery path, and elastic resume accepts a changed process
+    count.
     """
     if coordinator_address is not None or num_processes is not None:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
-                                   process_id=process_id)
+                                   process_id=process_id, **kwargs)
         return
     try:
         jax.distributed.initialize()

@@ -1,0 +1,85 @@
+"""Worker for the process-death failure-propagation test (the reference's
+``comm.Abort`` analogue, FL_CustomMLP...:203-205).
+
+Both processes run one good federated round over the 2-process mesh; then
+process 1 dies abruptly (``os._exit`` — no shutdown handshake, the SIGKILL
+shape). Process 0 keeps stepping: its next cross-process collective blocks,
+the coordination service notices the missed heartbeats within the (shortened)
+``heartbeat_timeout_seconds``, and the JAX runtime TERMINATES the survivor
+with a fatal "distributed service detected fatal errors" diagnostic. The
+parent test asserts exactly that: survivors die fast and loudly — they never
+hang and never keep computing a partial federation.
+"""
+
+import os
+import sys
+import time
+
+HEARTBEAT_S = 10
+
+
+def main():
+    pid, nprocs, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                 sys.argv[3], sys.argv[4])
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from fedtpu.parallel import multihost
+
+    multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nprocs, process_id=pid,
+                         heartbeat_timeout_seconds=HEARTBEAT_S)
+
+    import numpy as np
+    from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+    from fedtpu.data.sharding import pack_clients
+    from fedtpu.data.tabular import synthetic_income_like
+    from fedtpu.models import build_model
+    from fedtpu.ops import build_optimizer
+    from fedtpu.parallel.mesh import make_mesh
+    from fedtpu.parallel.round import build_round_fn, init_federated_state
+
+    x, y = synthetic_income_like(200, 6, 2)
+    packed = pack_clients(x, y, ShardConfig(num_clients=8, shuffle=False))
+    mesh = make_mesh(num_clients=8)
+    batch = multihost.distribute_client_batch(packed, mesh)
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(8,)))
+    tx = build_optimizer(OptimConfig())
+    state = init_federated_state(jax.random.key(1), mesh, 8, init_fn, tx,
+                                 same_init=True)
+    step = build_round_fn(mesh, apply_fn, tx, 2)
+
+    state, m = step(state, batch)
+    acc = float(np.asarray(m["client_mean"]["accuracy"]))
+    with open(os.path.join(outdir, f"death_round1_{pid}.txt"), "w") as f:
+        f.write(repr(acc))
+    print(f"worker {pid}: round 1 ok acc={acc:.4f}", flush=True)
+
+    if pid == 1:
+        print(f"worker {pid}: dying abruptly now", flush=True)
+        os._exit(77)
+
+    # Survivor: keep stepping AND fetching. The fetch is the part that can
+    # hang — it must instead end in the runtime terminating this process.
+    t0 = time.time()
+    for i in range(1000):
+        state, m = step(state, batch)
+        _ = float(np.asarray(m["client_mean"]["accuracy"]))
+        # Timestamped progress so the parent can verify the survivor was
+        # genuinely blocked (no post-death rounds complete), not looping.
+        with open(os.path.join(outdir, "survivor_progress.txt"), "a") as f:
+            f.write(f"{i} {time.time() - t0:.1f}\n")
+    # Unreachable if propagation works: the runtime must have killed us.
+    with open(os.path.join(outdir, "survivor_never_died.txt"), "w") as f:
+        f.write(f"{time.time() - t0:.1f}")
+    sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

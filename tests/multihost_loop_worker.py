@@ -54,7 +54,9 @@ def main():
     pid, nprocs, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
                                  sys.argv[3], sys.argv[4])
     mode = sys.argv[5] if len(sys.argv) > 5 else "plain"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    local = int(os.environ.get("FEDTPU_TEST_LOCAL_DEVICES", "4"))
+    os.environ["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={local}"
     os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax

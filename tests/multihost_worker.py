@@ -28,7 +28,12 @@ OUTER_STEPS = 2
 def main():
     pid, nprocs, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
                                  sys.argv[3], sys.argv[4])
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # Per-process virtual device count: the launcher scales processes and
+    # devices inversely (2 procs x 4 devices, 4 procs x 2 devices) so the
+    # global mesh is always the same 8 devices.
+    local = int(os.environ.get("FEDTPU_TEST_LOCAL_DEVICES", "4"))
+    os.environ["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={local}"
     os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
@@ -42,7 +47,7 @@ def main():
     multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
                          num_processes=nprocs, process_id=pid)
     assert jax.process_count() == nprocs
-    assert len(jax.devices()) == 4 * nprocs
+    assert len(jax.devices()) == local * nprocs
 
     import numpy as np
     from fedtpu.config import ModelConfig, OptimConfig, ShardConfig

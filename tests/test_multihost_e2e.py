@@ -25,33 +25,45 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_pair(worker_name, trailing_args, timeout):
-    """Run a 2-process worker pair to completion; always reaps the
-    processes. The free-port probe is inherently racy (the port is released
-    before the coordinator binds it), so one retry with a fresh port
-    absorbs a lost race instead of flaking."""
+def _spawn_group(worker_name, trailing_args, timeout, nprocs=2,
+                 local_devices=4):
+    """Spawn ``nprocs`` worker processes (``local_devices`` virtual CPU
+    devices each) on a fresh coordinator port and reap them; returns
+    ``[(proc, output), ...]``. Process/device split is the knob: 2x4 and
+    4x2 both form the same global 8-device mesh."""
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           worker_name)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["FEDTPU_TEST_LOCAL_DEVICES"] = str(local_devices)
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), str(nprocs), str(port),
+         *trailing_args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(nprocs)]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    except subprocess.TimeoutExpired:
+        outs = ["<timeout>"] * nprocs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return list(zip(procs, outs))
+
+
+def _launch_pair(worker_name, trailing_args, timeout, nprocs=2,
+                 local_devices=4):
+    """Run a worker group to successful completion. The free-port probe is
+    inherently racy (the port is released before the coordinator binds it),
+    so one retry with a fresh port absorbs a lost race instead of
+    flaking."""
     last = None
     for _ in range(2):
-        port = _free_port()
-        procs = [subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", str(port),
-             *trailing_args],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env) for pid in (0, 1)]
-        try:
-            outs = [p.communicate(timeout=timeout)[0] for p in procs]
-        except subprocess.TimeoutExpired:
-            outs = ["<timeout>", "<timeout>"]
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-                    p.wait()
-        last = list(zip(procs, outs))
+        last = _spawn_group(worker_name, trailing_args, timeout,
+                            nprocs=nprocs, local_devices=local_devices)
         if all(p.returncode == 0 for p, _ in last):
             return
     for p, out in last:
@@ -250,3 +262,79 @@ def test_two_process_grid_search(tmp_path):
         assert tuple(hl) == row["hidden_layer_sizes"]
         assert lr == row["learning_rate"]
         np.testing.assert_allclose(acc, row["accuracy"], atol=1e-5)
+
+
+def test_four_process_round_kernel(tmp_path):
+    """VERDICT r4 next #7: the kernel worker at FOUR processes with two
+    virtual devices each — same global 8-device mesh, now with every
+    collective crossing three process boundaries. All four processes must
+    hold the identical global model, matching the 2-process run's
+    contract (the worker's in-process assertions — ring==psum, tp-over-
+    DCN, int8, Byzantine median — all execute at this split too)."""
+    _launch_pair("multihost_worker.py", [str(tmp_path)], timeout=420,
+                 nprocs=4, local_devices=2)
+    params = [np.load(tmp_path / f"params_{pid}.npy") for pid in range(4)]
+    for p in params[1:]:
+        np.testing.assert_allclose(params[0], p, atol=1e-6)
+    accs = [float(open(tmp_path / f"acc_{pid}.txt").read())
+            for pid in range(4)]
+    assert len(set(accs)) == 1 and np.isfinite(accs[0])
+    tp_accs = [float(open(tmp_path / f"tp_acc_{pid}.txt").read())
+               for pid in range(4)]
+    assert len(set(tp_accs)) == 1 and np.isfinite(tp_accs[0])
+
+
+def test_four_process_loop_with_checkpointing(tmp_path):
+    """The full orchestration loop (pipelined stop + periodic collective
+    checkpoints + resume leg) at 4 processes x 2 devices: all four
+    histories identical, the distributed checkpoints complete on disk."""
+    import json
+
+    from tests import multihost_loop_worker as mlw
+
+    _launch_pair("multihost_loop_worker.py",
+                 [str(tmp_path), "pipelined_ckpt"], timeout=420,
+                 nprocs=4, local_devices=2)
+    runs = []
+    for pid in range(4):
+        with open(tmp_path / f"loop_{pid}.json") as f:
+            runs.append(json.load(f))
+    assert all(r == runs[0] for r in runs[1:])
+    assert runs[0]["rounds_run"] == mlw.ROUNDS
+    assert runs[0]["resume_rounds_run"] == mlw.RESUME_ROUNDS
+
+    from fedtpu.orchestration.checkpoint import latest_step
+    assert latest_step(str(tmp_path / "ck")) == mlw.RESUME_ROUNDS
+
+
+def test_process_death_terminates_survivors(tmp_path):
+    """The reference's `comm.Abort` analogue (FL_CustomMLP...:203-205),
+    executed: after one good round, process 1 dies abruptly (os._exit, no
+    handshake). The survivor's next collective must NOT hang and must NOT
+    keep computing a partial federation — the coordination service
+    detects the missed heartbeats (shortened to 10 s in the worker) and
+    TERMINATES the survivor with a fatal distributed-runtime diagnostic.
+    Semantics documented in fedtpu.parallel.multihost.initialize."""
+    results = _spawn_group("multihost_death_worker.py", [str(tmp_path)],
+                           timeout=180)
+    by_pid = {int(p.args[2]): (p, out) for p, out in results}
+    dead, dead_out = by_pid[1]
+    survivor, surv_out = by_pid[0]
+    # Round 1 completed on both before the death.
+    for pid in (0, 1):
+        assert np.isfinite(float(
+            open(tmp_path / f"death_round1_{pid}.txt").read()))
+    assert dead.returncode == 77, dead_out[-2000:]
+    # The survivor was terminated by the runtime: nonzero exit, within the
+    # harness timeout (not hung), with the fatal-propagation diagnostic.
+    assert survivor.returncode not in (0, 3), surv_out[-2000:]
+    assert not (tmp_path / "survivor_never_died.txt").exists()
+    assert ("distributed service detected fatal errors" in surv_out
+            or "unhealthy" in surv_out
+            or "DEADLINE_EXCEEDED" in surv_out
+            or "UNAVAILABLE" in surv_out), surv_out[-3000:]
+    # The survivor made essentially no post-death progress (its first
+    # blocked fetch may or may not have landed a buffered round).
+    prog = (tmp_path / "survivor_progress.txt")
+    lines = prog.read_text().splitlines() if prog.exists() else []
+    assert len(lines) <= 3, lines
