@@ -46,6 +46,13 @@ def _positive_int(text: str) -> int:
     return value
 
 
+def _nonnegative_int(text: str) -> int:
+    value = int(text)
+    if value < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0, got {value}")
+    return value
+
+
 def _nonnegative_float(text: str) -> float:
     value = float(text)
     if value < 0:
@@ -261,11 +268,12 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
     if getattr(args, "async_mode", False):
         fed = dataclasses.replace(fed, async_mode=True)
     elif any(getattr(args, a, None) is not None
-             for a in ("arrival_rate", "arrival_seed", "staleness_power")):
+             for a in ("arrival_rate", "arrival_seed", "staleness_power",
+                       "buffer_size")):
         # Never silently ignore a semantic knob: these only exist under
         # the async tick process.
-        raise SystemExit("--arrival-rate/--arrival-seed/--staleness-power "
-                         "require --async")
+        raise SystemExit("--arrival-rate/--arrival-seed/--staleness-power/"
+                         "--buffer-size require --async")
     if getattr(args, "arrival_rate", None) is not None:
         fed = dataclasses.replace(fed,
                                   async_arrival_rate=args.arrival_rate)
@@ -275,6 +283,9 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
     if getattr(args, "staleness_power", None) is not None:
         fed = dataclasses.replace(
             fed, async_staleness_power=args.staleness_power)
+    if getattr(args, "buffer_size", None) is not None:
+        fed = dataclasses.replace(fed,
+                                  async_buffer_size=args.buffer_size)
     run_kw = {}
     if args.checkpoint_dir is not None:
         run_kw["checkpoint_dir"] = args.checkpoint_dir
@@ -358,6 +369,12 @@ def build_parser() -> argparse.ArgumentParser:
                        help="async: arrival deltas are discounted "
                             "(1+staleness)^-p (default 0.5 = FedBuff's "
                             "1/sqrt; 0 disables discounting)")
+    run_p.add_argument("--buffer-size", type=_nonnegative_int,
+                       default=None,
+                       help="async: >= 2 selects true FedBuff K-buffer "
+                            "apply semantics — the global only moves once "
+                            "this many updates sit in the server buffer "
+                            "(default 0 = apply every arrival tick)")
     # run-only, like --aggregation: the sweep/parity programs would accept
     # but silently ignore it.
     run_p.add_argument("--personalize-steps", type=_positive_int,

@@ -251,6 +251,10 @@ class FedConfig:
     async_arrival_rate: float = 0.5      # P(client completes) per tick
     async_arrival_seed: int = 0
     async_staleness_power: float = 0.5   # delta discount (1+s)^-p; 0 = off
+    # >= 2 selects true FedBuff K-buffer apply semantics: the global only
+    # moves once this many updates sit in the server buffer (buffer state
+    # checkpoints with the run). <= 1 applies every arrival tick.
+    async_buffer_size: int = 0
     # The reference reads its stop signal one loop-top late (:132 vs :195)
     # but the doomed iteration breaks before training — no extra round is
     # trained, so there is no lag to reproduce (tests/test_stop_lag.py

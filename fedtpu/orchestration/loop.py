@@ -304,7 +304,8 @@ def build_experiment(cfg: ExperimentConfig,
         shard = client_sharding(mesh)
         state_fn = lambda: async_fed.init_async_state(
             jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
-            init_fn, tx, same_init=cfg.fed.same_init)
+            init_fn, tx, same_init=cfg.fed.same_init,
+            buffer_size=cfg.fed.async_buffer_size)
         step_fn = lambda r: async_fed.build_async_round_fn(
             mesh, apply_fn, tx, ds.num_classes,
             arrival_rate=cfg.fed.async_arrival_rate,
@@ -313,6 +314,7 @@ def build_experiment(cfg: ExperimentConfig,
             server_lr=cfg.fed.server_lr,
             local_steps=cfg.fed.local_steps,
             prox_mu=cfg.fed.prox_mu,
+            buffer_size=cfg.fed.async_buffer_size,
             ticks_per_step=r)
         global_fn = async_fed.async_global_params
     elif cfg.run.model_parallel > 1:

@@ -19,7 +19,10 @@ wall-clock event loop:
   ``s_i = tick - pull_tick_i``;
 - the server applies the arrival-mean of deltas, each discounted by
   ``1 / sqrt(1 + s_i)`` (FedBuff's staleness weight; ``staleness_power=0``
-  disables discounting), scaled by ``server_lr``;
+  disables discounting), scaled by ``server_lr`` — every arrival tick by
+  default, or, with ``buffer_size=M >= 2``, only once M updates have
+  accumulated in the server buffer (TRUE FedBuff's K-buffer apply rule;
+  the buffer persists in the state across calls and checkpoints);
 - completing clients re-pull: anchor <- the new global, pull_tick <- tick.
   Clients that did not complete keep their anchor — their eventual update
   grows STALER, which is exactly the dynamic under study.
@@ -56,9 +59,13 @@ from fedtpu.training.client import (make_local_eval_step,
 
 def init_async_state(key: jax.Array, mesh, num_clients: int,
                      init_fn: Callable, tx: optax.GradientTransformation,
-                     same_init: bool = True) -> dict:
+                     same_init: bool = True,
+                     buffer_size: int = 0) -> dict:
     """Per-client state + anchors. Every client starts having just pulled
-    the shared initial global (the uniform mean of the inits), tick 0."""
+    the shared initial global (the uniform mean of the inits), tick 0.
+    ``buffer_size >= 2`` adds the FedBuff server buffer
+    (``buf_delta``/``buf_count``, replicated, empty) so it persists across
+    compiled calls and checkpoints."""
     params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
     g0 = jax.tree.map(lambda p: p.mean(axis=0), params)
     anchors = jax.tree.map(
@@ -67,7 +74,18 @@ def init_async_state(key: jax.Array, mesh, num_clients: int,
     shard = client_sharding(mesh)
     put = lambda t: jax.device_put(t, shard)
     anchors = jax.tree.map(put, anchors)
+    extra = {}
+    if buffer_size >= 2:
+        from fedtpu.parallel.mesh import replicated_sharding
+        rep = replicated_sharding(mesh)
+        extra = {
+            "buf_delta": jax.tree.map(
+                lambda gl: jax.device_put(
+                    jnp.zeros(gl.shape, jnp.float32), rep), g0),
+            "buf_count": jax.device_put(jnp.zeros((), jnp.float32), rep),
+        }
     return {
+        **extra,
         # params start equal to the anchors but must be INDEPENDENT
         # buffers: on a single-device mesh device_put of an already-placed
         # array is a no-op, and aliased params/anchors leaves make the
@@ -90,6 +108,7 @@ def build_async_round_fn(mesh, apply_fn: Callable,
                          server_lr: float = 1.0,
                          local_steps: int = 1,
                          prox_mu: float = 0.0,
+                         buffer_size: int = 0,
                          ticks_per_step: int = 1) -> Callable:
     """Compile the async server tick. Returns ``step(state, batch) ->
     (state, metrics)`` over client-sharded batches, like the synchronous
@@ -99,6 +118,18 @@ def build_async_round_fn(mesh, apply_fn: Callable,
 
     ``staleness_power`` p: arrival i is discounted ``(1 + s_i)^-p``
     (p=0.5 is FedBuff's ``1/sqrt(1+s)``; p=0 disables discounting).
+
+    ``buffer_size`` M >= 2 selects TRUE FedBuff server semantics (Nguyen
+    et al. 2022): discounted deltas accumulate in a server-side buffer
+    and the global only moves once M updates have arrived (then the
+    buffer resets) — between applies, new arrivals pull the UNCHANGED
+    global. M <= 1 applies every arrival tick (the FedAsync-with-cohorts
+    cadence; M=1 is test-pinned bitwise identical to M=0, the default).
+    Buffered state (``buf_delta``/``buf_count``) persists in the state
+    dict across compiled calls and checkpoints; the buffer's pending
+    contributions are, by design, NOT in the evaluated/checkpointed
+    global until they apply. Requires ``init_async_state(...,
+    buffer_size=M)`` so the state carries the buffer keys.
     DONATES the input state — rebind, clone to keep."""
     if not 0.0 < arrival_rate <= 1.0:
         raise ValueError(f"arrival_rate must be in (0, 1], got "
@@ -108,6 +139,9 @@ def build_async_round_fn(mesh, apply_fn: Callable,
                          f"{staleness_power}")
     if server_lr <= 0:
         raise ValueError(f"server_lr must be > 0, got {server_lr}")
+    if buffer_size < 0:
+        raise ValueError(f"buffer_size must be >= 0, got {buffer_size}")
+    buffered = buffer_size >= 2
     # prox_mu's anchor is the params the step starts from — which here is
     # the client's pulled anchor, exactly the FedProx-against-stale-global
     # regularization FedBuff-style systems pair with many local steps.
@@ -117,12 +151,13 @@ def build_async_round_fn(mesh, apply_fn: Callable,
     local_eval = make_local_eval_step(apply_fn, num_classes)
     n_devices = mesh.devices.size
 
-    def tick_body(params, opt_state, anchors, pull, x, y, mask, rnd):
+    def tick_body(params, opt_state, anchors, pull, buf, nbuf, x, y, mask,
+                  rnd):
         cb = x.shape[0]
         gidx = jax.lax.axis_index(CLIENTS_AXIS) * cb + jnp.arange(cb)
 
         def scan_tick(carry, _):
-            params, opt_state, anchors, pull, g, r = carry
+            params, opt_state, anchors, pull, buf, nbuf, g, r = carry
 
             def per_client(cond, a, b):
                 return jnp.where(cond.reshape((cb,) + (1,) * (a.ndim - 1)),
@@ -151,18 +186,30 @@ def build_async_round_fn(mesh, apply_fn: Callable,
             disc = arrive * (1.0 + stale) ** -staleness_power
             n_arrived = jax.lax.psum(arrive.sum(), CLIENTS_AXIS)
 
-            def agg(tr, an):
+            def summed(tr, an):
                 delta = tr.astype(jnp.float32) - an.astype(jnp.float32)
                 local = jnp.tensordot(disc, delta, axes=1)
-                return (jax.lax.psum(local, CLIENTS_AXIS)
-                        / jnp.maximum(n_arrived, 1.0))
+                return jax.lax.psum(local, CLIENTS_AXIS)
 
-            mean_delta = jax.tree.map(agg, trained, anchors)
+            tick_sum = jax.tree.map(summed, trained, anchors)
+            # Server buffer: this tick's discounted deltas join; the
+            # global moves only once `apply_n` updates sit in the buffer,
+            # divided by the realized arrival count (== the per-tick
+            # arrival mean at M<=1, bitwise — the add of a zero buffer
+            # and the same division land on identical floats).
+            apply_n = buffer_size if buffered else 1
+            buf = jax.tree.map(jnp.add, buf, tick_sum)
+            nbuf = nbuf + n_arrived
+            apply = nbuf >= apply_n
             g = jax.tree.map(
-                lambda gl, md: jnp.where(
-                    n_arrived > 0,
-                    gl + server_lr * md.astype(gl.dtype), gl),
-                g, mean_delta)
+                lambda gl, b: jnp.where(
+                    apply,
+                    gl + server_lr
+                    * (b / jnp.maximum(nbuf, 1.0)).astype(gl.dtype), gl),
+                g, buf)
+            buf = jax.tree.map(
+                lambda b: jnp.where(apply, jnp.zeros_like(b), b), buf)
+            nbuf = jnp.where(apply, 0.0, nbuf)
             # Arrivals re-pull the fresh global; absentees keep aging.
             anchors = jax.tree.map(
                 lambda gl, an: per_client(arrive > 0, bcast_global(gl, an),
@@ -177,8 +224,8 @@ def build_async_round_fn(mesh, apply_fn: Callable,
             # because `pull` only moved for arrivals and pre-update
             # `stale` already equals (r - pull) for everyone else.
             report_stale = stale
-            return (params, opt_state, anchors, pull, g, r + 1), (
-                loss, conf, pooled, report_stale)
+            return (params, opt_state, anchors, pull, buf, nbuf, g,
+                    r + 1), (loss, conf, pooled, report_stale)
 
         # The current global, reconstructed once per compiled call from
         # the FRESHEST anchor: arrivals re-pull the new global right after
@@ -194,27 +241,41 @@ def build_async_round_fn(mesh, apply_fn: Callable,
                                                 keepdims=False)
 
         g0 = jax.tree.map(pick_freshest, anchors)
-        (params, opt_state, anchors, pull, _, _), stacked = jax.lax.scan(
-            scan_tick, (params, opt_state, anchors, pull, g0, rnd),
-            length=ticks_per_step)
+        (params, opt_state, anchors, pull, buf, nbuf, _, _), stacked = \
+            jax.lax.scan(
+                scan_tick,
+                (params, opt_state, anchors, pull, buf, nbuf, g0, rnd),
+                length=ticks_per_step)
         loss, conf, pooled, stale = stacked
-        return params, opt_state, anchors, pull, loss, conf, pooled, stale
+        return (params, opt_state, anchors, pull, buf, nbuf, loss, conf,
+                pooled, stale)
 
     spec_c = P(CLIENTS_AXIS)
     spec_rc = P(None, CLIENTS_AXIS)
     sharded = jax.shard_map(
         tick_body, mesh=mesh,
-        in_specs=(spec_c, spec_c, spec_c, spec_c, spec_c, spec_c, spec_c,
-                  P()),
-        out_specs=(spec_c, spec_c, spec_c, spec_c, spec_rc, spec_rc, P(),
-                   spec_rc),
+        in_specs=(spec_c, spec_c, spec_c, spec_c, P(), P(), spec_c, spec_c,
+                  spec_c, P()),
+        out_specs=(spec_c, spec_c, spec_c, spec_c, P(), P(), spec_rc,
+                   spec_rc, P(), spec_rc),
     )
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(state, batch):
-        (params, opt_state, anchors, pull, loss, conf, pooled,
+        if buffered and "buf_delta" not in state:
+            raise ValueError("buffer_size >= 2 needs a state initialized "
+                             "with init_async_state(..., buffer_size=M)")
+        # M<=1 runs the same program with an all-zero buffer carry that
+        # resets every arrival tick — no extra state keys, and bitwise
+        # the per-tick apply (test-pinned).
+        buf = (state["buf_delta"] if buffered else jax.tree.map(
+            lambda a: jnp.zeros(a.shape[1:], jnp.float32),
+            state["anchors"]))
+        nbuf = (state["buf_count"] if buffered
+                else jnp.zeros((), jnp.float32))
+        (params, opt_state, anchors, pull, buf, nbuf, loss, conf, pooled,
          stale) = sharded(state["params"], state["opt_state"],
-                          state["anchors"], state["pull_tick"],
+                          state["anchors"], state["pull_tick"], buf, nbuf,
                           batch["x"], batch["y"], batch["mask"],
                           state["round"])
         metrics = assemble_metrics(loss, conf, pooled, batch["mask"],
@@ -223,6 +284,9 @@ def build_async_round_fn(mesh, apply_fn: Callable,
         new_state = {"params": params, "opt_state": opt_state,
                      "anchors": anchors, "pull_tick": pull,
                      "round": state["round"] + ticks_per_step}
+        if buffered:
+            new_state["buf_delta"] = buf
+            new_state["buf_count"] = nbuf
         return new_state, metrics
 
     return step

@@ -319,3 +319,102 @@ def test_cli_async_knobs_without_async_rejected():
     args = build_parser().parse_args(["run", "--arrival-rate", "0.25"])
     with pytest.raises(SystemExit, match="require --async"):
         _apply_overrides(get_preset(args.preset), args)
+
+
+# ------------------------------------------------------------ FedBuff buffer
+def test_buffer_size_one_is_bitwise_the_per_tick_apply():
+    """M<=1 degenerate contract: the buffered program with an always-
+    resetting buffer computes the identical float sequence as the default
+    per-arrival-tick apply."""
+    mesh, init_fn, apply_fn, tx, batch = _fixtures()
+    outs = {}
+    for m in (0, 1):
+        state = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                           init_fn, tx, buffer_size=m)
+        step = async_fed.build_async_round_fn(
+            mesh, apply_fn, tx, 2, arrival_rate=0.4, arrival_seed=1,
+            buffer_size=m, ticks_per_step=10)
+        state, _ = step(state, batch)
+        outs[m] = jax.tree.map(np.asarray,
+                               async_fed.async_global_params(state))
+    for a, b in zip(jax.tree.leaves(outs[0]), jax.tree.leaves(outs[1])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_buffered_apply_waits_for_m_updates():
+    """True FedBuff semantics: with arrival_rate=1 and C=8 clients, every
+    tick contributes 8 updates, so M=16 applies exactly every 2nd tick —
+    the global is UNCHANGED after tick 1 and moves after tick 2."""
+    mesh, init_fn, apply_fn, tx, batch = _fixtures()
+
+    def global_after(ticks):
+        state = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                           init_fn, tx, buffer_size=16)
+        step = async_fed.build_async_round_fn(
+            mesh, apply_fn, tx, 2, arrival_rate=1.0, staleness_power=0.0,
+            buffer_size=16, ticks_per_step=1)
+        counts = []
+        for _ in range(ticks):
+            state, _ = step(state, batch)
+            counts.append(float(np.asarray(state["buf_count"])))
+        return (jax.tree.map(np.asarray,
+                             async_fed.async_global_params(state)), counts)
+
+    g0 = jax.tree.map(
+        np.asarray,
+        async_fed.async_global_params(async_fed.init_async_state(
+            jax.random.key(0), mesh, C, init_fn, tx, buffer_size=16)))
+    g1, c1 = global_after(1)
+    g2, c2 = global_after(2)
+    # Tick 1: 8 < 16 buffered — global untouched, buffer half full.
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_array_equal(a, b)
+    assert c1 == [8.0]
+    # Tick 2: 16 >= 16 — apply fires, buffer resets.
+    moved = max(float(np.abs(a - b).max())
+                for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)))
+    assert moved > 1e-6
+    assert c2 == [8.0, 0.0]
+    # And the M=16 trajectory over 2 ticks equals ONE synchronous apply
+    # of all 16 accumulated (2-tick) updates — which differs from the
+    # M=0 per-tick trajectory (two sequential applies).
+    state0 = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                        init_fn, tx)
+    step0 = async_fed.build_async_round_fn(
+        mesh, apply_fn, tx, 2, arrival_rate=1.0, staleness_power=0.0,
+        ticks_per_step=2)
+    state0, _ = step0(state0, batch)
+    g_seq = jax.tree.map(np.asarray, async_fed.async_global_params(state0))
+    assert max(float(np.abs(a - b).max())
+               for a, b in zip(jax.tree.leaves(g2),
+                               jax.tree.leaves(g_seq))) > 1e-6
+
+
+def test_buffered_state_checkpoints_and_resumes_bitwise(tmp_path):
+    """The server buffer is run state: save mid-buffer -> restore -> tick
+    must be bitwise identical to uninterrupted ticking (a dropped buffer
+    would silently lose the pending contributions)."""
+    def cfg(rounds, d):
+        base = _async_cfg(rounds=rounds, arrival=0.3)
+        return dataclasses.replace(
+            base,
+            fed=dataclasses.replace(base.fed, async_buffer_size=6),
+            run=RunConfig(checkpoint_dir=str(d), checkpoint_every=3,
+                          log_every=1000))
+    r_full = run_experiment(cfg(9, tmp_path / "a"), verbose=False)
+    run_experiment(cfg(3, tmp_path / "b"), verbose=False)
+    r_res = run_experiment(cfg(9, tmp_path / "b"), verbose=False,
+                           resume=True)
+    for a, b in zip(jax.tree.leaves(r_full.final_params),
+                    jax.tree.leaves(r_res.final_params)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_buffered_step_requires_buffered_state():
+    mesh, init_fn, apply_fn, tx, batch = _fixtures()
+    state = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                       init_fn, tx)          # no buffer keys
+    step = async_fed.build_async_round_fn(mesh, apply_fn, tx, 2,
+                                          buffer_size=4)
+    with pytest.raises(ValueError, match="buffer_size"):
+        step(state, batch)
