@@ -125,7 +125,7 @@ def initialize(coordinator_address: Optional[str] = None,
                                    process_id=process_id, **kwargs)
         return
     try:
-        jax.distributed.initialize()
+        jax.distributed.initialize(**kwargs)
     except Exception as e:
         if _looks_multihost():
             raise RuntimeError(
