@@ -53,9 +53,13 @@ themselves ride the inter-process link.
 
 Round 5 widens the executed matrix: the same kernel worker and the full
 pipelined-checkpoint loop also run at FOUR processes x two devices each
-(every collective crossing three process boundaries), and process-death
-failure propagation is executed, not assumed — see ``initialize``'s
-docstring for the semantics (the ``comm.Abort`` analogue).
+(every collective crossing three process boundaries); the productized
+ASYNC engine runs its full loop across processes too (Bernoulli
+arrivals, the FedBuff K-buffer, staleness metrics, collective
+checkpoints + resume — matching the single-process trajectory exactly);
+and process-death failure propagation is executed, not assumed — see
+``initialize``'s docstring for the semantics (the ``comm.Abort``
+analogue).
 """
 
 from __future__ import annotations

@@ -34,17 +34,27 @@ def experiment_config(mode: str = "plain", ckpt_dir=None):
     from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
                                ModelConfig, RunConfig, ShardConfig)
     run_kw = {}
+    fed_kw = {}
     if mode == "pipelined_ckpt":
         run_kw = {"pipelined_stop": True, "checkpoint_dir": ckpt_dir,
                   "checkpoint_every": 4}
     elif mode == "tp":
         run_kw = {"model_parallel": 2}
+    elif mode == "async":
+        # The productized async engine under jax.distributed: Bernoulli
+        # arrivals, FedBuff K-buffer (M=6), staleness metrics — the
+        # freshest-anchor gather, buffer carry, and arrival psum all
+        # crossing the process boundary; checkpointing stays collective.
+        fed_kw = {"async_mode": True, "weighting": "uniform",
+                  "async_arrival_rate": 0.5, "async_buffer_size": 6}
+        run_kw = {"checkpoint_dir": ckpt_dir, "checkpoint_every": 4}
     return ExperimentConfig(
         data=DataConfig(csv_path=None, synthetic_rows=ROWS,
                         synthetic_features=FEATURES),
         shard=ShardConfig(num_clients=NUM_CLIENTS, shuffle=False),
         model=ModelConfig(input_dim=FEATURES, hidden_sizes=HIDDEN),
-        fed=FedConfig(rounds=ROUNDS, tolerance=0.0, same_init=True),
+        fed=FedConfig(rounds=ROUNDS, tolerance=0.0, same_init=True,
+                      **fed_kw),
         run=RunConfig(rounds_per_step=ROUNDS_PER_STEP,
                       eval_test_every=EVAL_TEST_EVERY, **run_kw),
     )
@@ -115,10 +125,15 @@ def main():
         "per_client_last": np.asarray(
             res.per_client_metrics["accuracy"][-1]).tolist(),
     }
-    if mode == "pipelined_ckpt":
+    if mode == "async":
+        out["staleness_mean"] = float(np.mean(
+            [s.mean() for s in res.staleness]))
+        out["staleness_max"] = float(max(s.max() for s in res.staleness))
+    if mode in ("pipelined_ckpt", "async"):
         # Resume leg: a fresh run_experiment restores the DISTRIBUTED
         # checkpoint (written collectively above) on every process and
-        # continues the round loop — the multi-process restore path.
+        # continues the round loop — the multi-process restore path (for
+        # async, incl. anchors/pull_tick and the mid-run K-buffer).
         import dataclasses
         cfg2 = experiment_config(mode, ckpt_dir)
         cfg2 = dataclasses.replace(

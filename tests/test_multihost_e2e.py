@@ -338,3 +338,25 @@ def test_process_death_terminates_survivors(tmp_path):
     prog = (tmp_path / "survivor_progress.txt")
     lines = prog.read_text().splitlines() if prog.exists() else []
     assert len(lines) <= 3, lines
+
+
+def test_two_process_async_loop_matches_single_process(tmp_path):
+    """The productized async FedBuff engine under jax.distributed: tick
+    metrics, staleness, the K-buffer (M=6), collective checkpoints, and a
+    resume leg — all across two processes, matching the single-process
+    run exactly (arrival draws are deterministic in (seed, tick, client),
+    so the trajectories must agree to collective-reassociation floats)."""
+    from tests import multihost_loop_worker as mlw
+
+    runs = _run_loop_workers(tmp_path, mode="async")
+    assert runs[0]["rounds_run"] == mlw.ROUNDS
+    assert runs[0]["staleness_max"] >= 1          # arrivals genuinely sparse
+    assert runs[0]["resume_rounds_run"] == mlw.RESUME_ROUNDS
+
+    from fedtpu.orchestration.loop import run_experiment
+    single = run_experiment(mlw.experiment_config("async"), verbose=False)
+    np.testing.assert_allclose(runs[0]["accuracy"],
+                               single.global_metrics["accuracy"], atol=1e-5)
+    np.testing.assert_allclose(
+        runs[0]["staleness_mean"],
+        float(np.mean([s.mean() for s in single.staleness])), atol=1e-6)
