@@ -343,8 +343,8 @@ def build_parser() -> argparse.ArgumentParser:
     run_p.add_argument("--pipelined-stop", action="store_true",
                        help="overlap metric processing with the next "
                             "chunk's device execution; stop decisions lag "
-                            "one chunk (the reference's stop signal has "
-                            "the same lag)")
+                            "one chunk (recorded history stays identical "
+                            "to the synchronous loop)")
     run_p.add_argument("--resume", action="store_true",
                        help="resume from the latest checkpoint in "
                             "--checkpoint-dir")

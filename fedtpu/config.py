@@ -290,9 +290,11 @@ class RunConfig:
     # Overlap host-side metric processing with the NEXT chunk's device
     # execution (one chunk kept in flight). Removes one dispatch+fetch RTT
     # per chunk (the dominant per-chunk cost through a remote transport) at
-    # the price of stop decisions lagging one chunk — the reference's own
-    # stop-signal bcast has the same one-step lag (FL_CustomMLP...:132 vs
-    # :195). Default off: exact synchronous stop semantics.
+    # the price of stop decisions lagging one chunk. (The reference's
+    # stop-signal bcast is also read one loop-top late — :132 vs :195 —
+    # but its doomed iteration breaks before training, so unlike this
+    # mode it never trains past the stop; tests/test_stop_lag.py.)
+    # Default off: exact synchronous stop semantics.
     pipelined_stop: bool = False
     # >1 selects the 2-D ('clients','model') GSPMD engine
     # (fedtpu.parallel.tp): hidden weights shard over a tensor-parallel axis

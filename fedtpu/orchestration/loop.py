@@ -574,62 +574,102 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     print(f"Resumed from checkpoint at round {start_round}.",
                           flush=True)
             else:
-                if "anchors" in state or "anchors" in raw:
-                    # Async state is NOT post-averaging: slots hold
-                    # distinct per-client models and the global lives in
-                    # the freshest anchor, so the mean-over-slots collapse
-                    # below would resume from a model nobody trained.
-                    # Checked on BOTH sides: the live template (async
-                    # config) and the checkpoint contents (an async-written
-                    # checkpoint resumed under a sync config must not
-                    # silently collapse either).
+                if ("anchors" in state) != ("anchors" in raw):
+                    # Engine mismatch either way: async state is NOT
+                    # post-averaging (slots hold distinct local models),
+                    # so a sync resume of an async checkpoint would
+                    # mean-collapse models nobody trained, and an async
+                    # resume of a sync checkpoint has no pull/anchor
+                    # history to restore.
                     raise ValueError(
-                        "elastic resume (changed num_clients) is not "
-                        "supported for async-engine state; resume with "
-                        f"the saved client count ({saved_c}) or "
-                        "warm-start a fresh run from exported weights "
-                        "instead")
-                # ELASTIC resume — the cluster grew or shrank (the reference
-                # cannot do this at all: client count is baked into `mpirun
-                # -np N`). Periodic checkpoints hold a post-averaging state,
-                # so every client slot is the same global model: collapse to
-                # the global (mean over slots == slot 0), re-broadcast over
-                # the NEW client count, and restore the client-count-
-                # independent server-optimizer state as-is. Per-client Adam
-                # moments cannot be re-shaped meaningfully across counts —
-                # they restart fresh (the same state a client joining a
-                # federation starts with).
-                g = jax.tree.map(lambda a: np.asarray(a).mean(axis=0),
-                                 raw["params"])
-                state["params"] = _bcast_into_slots(g, state["params"])
-                if ("server_opt_state" in raw
-                        and "server_opt_state" in state):
-                    state["server_opt_state"] = jax.tree.map(
-                        lambda live, rawv: jax.device_put(
-                            np.asarray(rawv), live.sharding),
-                        state["server_opt_state"], raw["server_opt_state"])
-                if "dp_clip" in raw and "dp_clip" in state:
-                    # The adaptive clip is client-count-independent server
-                    # state — carry it like the server optimizer state.
-                    state["dp_clip"] = jax.device_put(
-                        np.asarray(raw["dp_clip"]),
-                        state["dp_clip"].sharding)
-                state["round"] = jnp.asarray(raw_round, jnp.int32)
-                restored_history, start_round = raw_history, raw_round
-                if verbose:
-                    # Per-client SCAFFOLD variates are client-count-shaped
-                    # like the Adam moments: an elastic resume restarts
-                    # them at zero (invariant-consistent; the correction
-                    # re-warms over the next rounds) — say so, or a drift
-                    # study across a resume sees an unexplained regression.
-                    cv_note = (", control variates reset to zero"
-                               if "client_cv" in state else "")
-                    print(f"Elastic resume at round {raw_round}: "
-                          f"{saved_num_clients(raw)} -> "
-                          f"{cfg.shard.num_clients} clients (global model "
-                          "carried over, fresh client optimizer state"
-                          f"{cv_note}).",
-                          flush=True)
+                        "elastic resume engine mismatch: the checkpoint "
+                        f"was written by the "
+                        f"{'async' if 'anchors' in raw else 'synchronous'}"
+                        " engine but the current config selects the other"
+                        "; resume with the matching engine (and client "
+                        f"count {saved_c}), or warm-start a fresh run "
+                        "from exported weights")
+                if "anchors" in state:
+                    # ASYNC elastic resume: a restart IS every client
+                    # re-pulling the current global — which lives in the
+                    # FRESHEST anchor, not a mean over slots (slots hold
+                    # distinct per-client local models). New cohort:
+                    # params = anchors = that global, pull ticks at the
+                    # resume tick (staleness restarts at 0), fresh Adam
+                    # moments, and any PENDING K-buffer contributions are
+                    # dropped (their deltas reference anchors of a cohort
+                    # that no longer exists) — said out loud below.
+                    from fedtpu.parallel.async_fed import \
+                        async_global_params
+                    # The engine's own freshest-anchor rule (ONE
+                    # definition); works on the raw numpy tree at the
+                    # saved client count.
+                    g = jax.tree.map(np.asarray, async_global_params(raw))
+                    state["params"] = _bcast_into_slots(g, state["params"])
+                    state["anchors"] = _bcast_into_slots(g,
+                                                         state["anchors"])
+                    state["pull_tick"] = jax.device_put(
+                        np.full(cfg.shard.num_clients, raw_round, np.int32),
+                        state["pull_tick"].sharding)
+                    state["round"] = jnp.asarray(raw_round, jnp.int32)
+                    dropped = float(np.asarray(raw.get("buf_count", 0.0)))
+                    restored_history, start_round = raw_history, raw_round
+                    if verbose:
+                        buf_note = (f", {int(dropped)} pending buffered "
+                                    "updates dropped" if dropped > 0
+                                    else "")
+                        print(f"Async elastic resume at tick {raw_round}: "
+                              f"{saved_c} -> {cfg.shard.num_clients} "
+                              "clients (freshest-anchor global carried "
+                              "over, every client re-pulled, fresh "
+                              f"optimizer state{buf_note}).",
+                              flush=True)
+                else:
+                    # SYNC ELASTIC resume — the cluster grew or shrank
+                    # (the reference cannot do this at all: client count
+                    # is baked into `mpirun -np N`). Periodic checkpoints
+                    # hold a post-averaging state, so every client slot is
+                    # the same global model: collapse to the global (mean
+                    # over slots == slot 0), re-broadcast over the NEW
+                    # client count, and restore the client-count-
+                    # independent server-optimizer state as-is. Per-client
+                    # Adam moments cannot be re-shaped meaningfully across
+                    # counts — they restart fresh (the same state a client
+                    # joining a federation starts with).
+                    g = jax.tree.map(lambda a: np.asarray(a).mean(axis=0),
+                                     raw["params"])
+                    state["params"] = _bcast_into_slots(g, state["params"])
+                    if ("server_opt_state" in raw
+                            and "server_opt_state" in state):
+                        state["server_opt_state"] = jax.tree.map(
+                            lambda live, rawv: jax.device_put(
+                                np.asarray(rawv), live.sharding),
+                            state["server_opt_state"],
+                            raw["server_opt_state"])
+                    if "dp_clip" in raw and "dp_clip" in state:
+                        # The adaptive clip is client-count-independent
+                        # server state — carry it like the server
+                        # optimizer state.
+                        state["dp_clip"] = jax.device_put(
+                            np.asarray(raw["dp_clip"]),
+                            state["dp_clip"].sharding)
+                    state["round"] = jnp.asarray(raw_round, jnp.int32)
+                    restored_history, start_round = raw_history, raw_round
+                    if verbose:
+                        # Per-client SCAFFOLD variates are client-count-
+                        # shaped like the Adam moments: an elastic resume
+                        # restarts them at zero (invariant-consistent; the
+                        # correction re-warms over the next rounds) — say
+                        # so, or a drift study across a resume sees an
+                        # unexplained regression.
+                        cv_note = (", control variates reset to zero"
+                                   if "client_cv" in state else "")
+                        print(f"Elastic resume at round {raw_round}: "
+                              f"{saved_num_clients(raw)} -> "
+                              f"{cfg.shard.num_clients} clients (global "
+                              "model carried over, fresh client optimizer "
+                              f"state{cv_note}).",
+                              flush=True)
 
     # DP RDP bookkeeping lives in its own module (fedtpu.orchestration.
     # privacy): the cumulative per-order RDP curve is the resumable

@@ -214,17 +214,46 @@ def test_async_chunked_ticks_bitwise():
         np.testing.assert_array_equal(a, b)
 
 
-def test_async_elastic_resume_rejected(tmp_path):
-    cfg = dataclasses.replace(
-        _async_cfg(rounds=3),
-        run=RunConfig(checkpoint_dir=str(tmp_path), checkpoint_every=3,
-                      log_every=1000))
-    run_experiment(cfg, verbose=False)
-    grown = dataclasses.replace(
-        cfg, shard=ShardConfig(num_clients=4),
-        fed=dataclasses.replace(cfg.fed, rounds=6))
-    with pytest.raises(ValueError, match="elastic resume"):
-        run_experiment(grown, verbose=False, resume=True)
+def test_async_elastic_resume_carries_the_freshest_anchor(tmp_path):
+    """Async elastic resume (round 5): a restart IS every client
+    re-pulling the freshest anchor. Pin: with lr=0 the global can never
+    move, so the elastic leg's final global must equal the first leg's
+    EXACTLY — any mean-over-slots collapse (the sync rule) would mix
+    distinct local models and break this."""
+    from fedtpu.config import OptimConfig
+    def cfg(rounds, clients):
+        return dataclasses.replace(
+            _async_cfg(rounds=rounds),
+            shard=ShardConfig(num_clients=clients),
+            optim=OptimConfig(learning_rate=0.0),
+            run=RunConfig(checkpoint_dir=str(tmp_path), checkpoint_every=4,
+                          log_every=1000))
+    first = run_experiment(cfg(4, 8), verbose=False)
+    grown = run_experiment(cfg(8, 4), verbose=False, resume=True)
+    assert grown.rounds_run == 8
+    assert len(grown.global_metrics["accuracy"]) == 8   # history carried
+    for a, b in zip(jax.tree.leaves(first.final_params),
+                    jax.tree.leaves(grown.final_params)):
+        np.testing.assert_array_equal(a, b)
+    # Staleness restarted at the resume tick: everyone re-pulled, so no
+    # age can exceed the 4 post-resume ticks.
+    assert max(s.max() for s in grown.staleness) <= 4
+
+
+def test_async_elastic_resume_drops_pending_buffer_loudly(tmp_path, capsys):
+    def cfg(rounds, clients):
+        base = _async_cfg(rounds=rounds, arrival=1.0)
+        return dataclasses.replace(
+            base,
+            shard=ShardConfig(num_clients=clients),
+            fed=dataclasses.replace(base.fed, async_buffer_size=10 ** 6),
+            run=RunConfig(checkpoint_dir=str(tmp_path), checkpoint_every=4,
+                          log_every=1000))
+    run_experiment(cfg(4, 8), verbose=False)        # 32 updates pending
+    run_experiment(cfg(8, 4), verbose=True, resume=True)
+    out = capsys.readouterr().out
+    assert "Async elastic resume at tick 4: 8 -> 4 clients" in out
+    assert "32 pending buffered updates dropped" in out
 
 
 @pytest.mark.parametrize("fed_kw,match", [
@@ -309,7 +338,7 @@ def test_async_checkpoint_resumed_under_sync_config_not_collapsed(tmp_path):
     sync_grown = dataclasses.replace(
         cfg, shard=ShardConfig(num_clients=4),
         fed=dataclasses.replace(cfg.fed, async_mode=False, rounds=6))
-    with pytest.raises(ValueError, match="async-engine state"):
+    with pytest.raises(ValueError, match="engine mismatch"):
         run_experiment(sync_grown, verbose=False, resume=True)
 
 
@@ -418,3 +447,22 @@ def test_buffered_step_requires_buffered_state():
                                           buffer_size=4)
     with pytest.raises(ValueError, match="buffer_size"):
         step(state, batch)
+
+
+def test_sync_checkpoint_under_async_config_rejected(tmp_path):
+    """Reverse engine mismatch: a sync-written checkpoint elastically
+    resumed under --async has no pull/anchor history to restore."""
+    from fedtpu.config import OptimConfig
+    sync_cfg = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=512),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=3, termination_patience=1000),
+        run=RunConfig(checkpoint_dir=str(tmp_path), checkpoint_every=3,
+                      log_every=1000))
+    run_experiment(sync_cfg, verbose=False)
+    async_grown = dataclasses.replace(
+        sync_cfg, shard=ShardConfig(num_clients=4),
+        fed=FedConfig(rounds=6, weighting="uniform", async_mode=True,
+                      termination_patience=1000))
+    with pytest.raises(ValueError, match="engine mismatch"):
+        run_experiment(async_grown, verbose=False, resume=True)
