@@ -191,3 +191,25 @@ def pytest_collection_modifyitems(config, items):
     if uncovered:
         raise pytest.UsageError(
             f"test modules with no quick-tier test: {sorted(uncovered)}")
+
+
+# ------------------------------------------------------- native-cache hygiene
+# The full suite compiles hundreds of XLA programs across 36 modules; the
+# executables (and their buffers) accumulate memory MAPPINGS for the whole
+# pytest process lifetime. Around ~280 tests in, the map count approaches
+# the kernel's default vm.max_map_count (65530) and the next native mmap
+# fails => C++ abort => "Fatal Python error: Aborted" in whichever test
+# happens to run there (observed twice, deterministically, in
+# test_robust.py — a test that passes alone in seconds). Dropping JAX's
+# compilation caches at module boundaries releases the executables;
+# cross-module cache hits are rare (each module compiles its own shapes),
+# so the wall-clock cost is negligible next to the crash it prevents.
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    yield
+    import gc
+
+    import jax
+
+    jax.clear_caches()
+    gc.collect()
