@@ -36,6 +36,7 @@ is not divided.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import pickle
@@ -339,11 +340,50 @@ def bench_reference_equivalent(ds) -> dict:
             "compute_s": tc, "serial_s": ts, "assumed_parallelism": parallel}
 
 
-def main():
-    ds = _dataset()
-    ours = bench_fedtpu(ds)
-    capability = bench_mfu_capability(ours["peak_flops_measured"])
-    base = bench_reference_equivalent(ds)
+def emit_result(result: dict, detail_lines, out_path=None) -> str:
+    """Emit the benchmark artifact in consumer-safe order.
+
+    Detail lines go to stderr FIRST, then the full JSON blob is written to
+    ``out_path`` (when given) and printed LAST on stdout. Harnesses that
+    read "the last stdout line" or "everything after the last brace" get a
+    complete, parseable document — the earlier ordering (JSON first) let
+    interleaved stream flushing truncate the blob and parse to null.
+    """
+    for line in detail_lines:
+        print(line, file=sys.stderr)
+    blob = json.dumps(result)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(blob + "\n")
+    sys.stderr.flush()
+    print(blob, flush=True)
+    return blob
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="BENCH_RESULT.json",
+                    help="file the full JSON result is written to "
+                         "(default: %(default)s)")
+    ap.add_argument("--events", default=None, metavar="PATH",
+                    help="telemetry JSONL sink for per-stage bench spans "
+                         "(inspect with 'fedtpu report PATH')")
+    args = ap.parse_args(argv)
+
+    from fedtpu.telemetry import build_manifest, make_tracer
+    tracer = make_tracer(args.events)
+    if tracer.enabled:
+        tracer.event("manifest", **build_manifest(
+            extra={"program": "bench", "headline_rps": HEADLINE_RPS}))
+
+    with tracer.span("dataset"):
+        ds = _dataset()
+    with tracer.span("bench_fedtpu"):
+        ours = bench_fedtpu(ds)
+    with tracer.span("mfu_capability"):
+        capability = bench_mfu_capability(ours["peak_flops_measured"])
+    with tracer.span("baseline"):
+        base = bench_reference_equivalent(ds)
     lo, hi = ours["sec_per_round_range"]
     g3 = lambda v: float(f"{v:.3g}")
     result = {
@@ -387,35 +427,44 @@ def main():
                 / ours["sec_per_round"]),
         },
     }
-    print(json.dumps(result))
-    # Detail lines on stderr so stdout stays one JSON line.
-    print(f"[bench] headline (rps={HEADLINE_RPS}, pipelined): "
-          f"{ours['sec_per_round']:.3e} s/round "
-          f"(window band [{lo:.3e}, {hi:.3e}]; "
-          f"synchronous {ours['sec_per_round_sync']:.3e}), "
-          f"accuracy {ours['accuracy']:.4f}, devices {ours['devices']}, "
-          f"backend {ours['backend']}, measured peak "
-          f"{ours['peak_flops_measured'] / 1e12:.1f} TFLOP/s, "
-          f"{ours['flops_per_round']:.2e} FLOPs/round, "
-          f"MFU {100 * ours['mfu']:.1f}%",
-          file=sys.stderr)
-    print(f"[bench] MFU capability (hidden {capability['hidden']}, "
-          f"{capability['rows_per_client']} rows/client, slope-timed): "
-          f"{capability['marginal_s_per_round']:.3e} s/round, "
-          f"{capability['flops_per_round']:.2e} FLOPs/round, "
-          f"MFU {100 * capability['mfu']:.1f}% — the income headline above "
-          "is byte-bound at its own roofline (RESULTS.md)", file=sys.stderr)
+    # Detail lines accumulate here and hit stderr BEFORE the JSON blob —
+    # the complete JSON must be the LAST thing on stdout (emit_result).
+    detail = [
+        f"[bench] headline (rps={HEADLINE_RPS}, pipelined): "
+        f"{ours['sec_per_round']:.3e} s/round "
+        f"(window band [{lo:.3e}, {hi:.3e}]; "
+        f"synchronous {ours['sec_per_round_sync']:.3e}), "
+        f"accuracy {ours['accuracy']:.4f}, devices {ours['devices']}, "
+        f"backend {ours['backend']}, measured peak "
+        f"{ours['peak_flops_measured'] / 1e12:.1f} TFLOP/s, "
+        f"{ours['flops_per_round']:.2e} FLOPs/round, "
+        f"MFU {100 * ours['mfu']:.1f}%",
+        f"[bench] MFU capability (hidden {capability['hidden']}, "
+        f"{capability['rows_per_client']} rows/client, slope-timed): "
+        f"{capability['marginal_s_per_round']:.3e} s/round, "
+        f"{capability['flops_per_round']:.2e} FLOPs/round, "
+        f"MFU {100 * capability['mfu']:.1f}% — the income headline above "
+        "is byte-bound at its own roofline (RESULTS.md)",
+    ]
     for rps, row in ours["sweep"].items():
-        print(f"[bench] rps={rps:>4}: pipelined "
-              f"{row['sec_per_round']:.3e} s/round, sync "
-              f"{row['sec_per_round_sync']:.3e} s/round "
-              f"(floor {row['floor_sec']:.3e}, "
-              f"MFU {100 * row['mfu']:.1f}%, "
-              f"{row['rounds_timed']} rounds/window, "
-              f"{row['rounds_trained']} trained)", file=sys.stderr)
-    print(f"[bench] baseline(measured reference-equivalent): {base} — "
-          "compute credited /min(8, cpu_count); an 8-core host shrinks "
-          "the baseline and the speedup accordingly", file=sys.stderr)
+        detail.append(
+            f"[bench] rps={rps:>4}: pipelined "
+            f"{row['sec_per_round']:.3e} s/round, sync "
+            f"{row['sec_per_round_sync']:.3e} s/round "
+            f"(floor {row['floor_sec']:.3e}, "
+            f"MFU {100 * row['mfu']:.1f}%, "
+            f"{row['rounds_timed']} rounds/window, "
+            f"{row['rounds_trained']} trained)")
+    detail.append(
+        f"[bench] baseline(measured reference-equivalent): {base} — "
+        "compute credited /min(8, cpu_count); an 8-core host shrinks "
+        "the baseline and the speedup accordingly")
+    if args.out:
+        detail.append(f"[bench] full JSON result written to {args.out}")
+    emit_result(result, detail, out_path=args.out)
+    tracer.event("bench_end", headline_s=result["value"],
+                 vs_baseline=result["vs_baseline"])
+    tracer.close()
 
 
 if __name__ == "__main__":

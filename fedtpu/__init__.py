@@ -19,6 +19,7 @@ Public API (stable):
     fedtpu.orchestration — host round loop, early stopping, checkpointing
     fedtpu.sweep       — federated hyperparameter grid search
     fedtpu.parity      — sklearn MLPClassifier warm-start comparison path
+    fedtpu.telemetry   — tracing, metrics, run manifests, `fedtpu report`
 """
 
 __version__ = "0.1.0"
@@ -30,6 +31,7 @@ from fedtpu.config import (  # noqa: F401
     OptimConfig,
     FedConfig,
     RunConfig,
+    TelemetryConfig,
     ExperimentConfig,
     PRESETS,
     get_preset,
@@ -59,6 +61,13 @@ _LAZY = {
     "timed_rounds": ("fedtpu.utils.timing", "timed_rounds"),
     "compile_with_flops": ("fedtpu.utils.timing", "compile_with_flops"),
     "measured_peak_flops": ("fedtpu.utils.timing", "measured_peak_flops"),
+    # Telemetry (docs/observability.md). The package itself is
+    # import-light (stdlib only) but stays lazy for symmetry.
+    "make_tracer": ("fedtpu.telemetry.trace", "make_tracer"),
+    "default_registry": ("fedtpu.telemetry.metrics", "default_registry"),
+    "build_manifest": ("fedtpu.telemetry.manifest", "build_manifest"),
+    "TelemetryLogger": ("fedtpu.telemetry.log", "TelemetryLogger"),
+    "render_report": ("fedtpu.telemetry.report", "render_report"),
 }
 
 

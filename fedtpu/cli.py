@@ -173,6 +173,11 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                    help="write a jax.profiler trace of the round loop here")
     p.add_argument("--metrics-jsonl", default=None,
                    help="append one JSON line of metrics per round")
+    p.add_argument("--events", default=None, metavar="JSONL",
+                   help="append structured telemetry events here (run "
+                        "manifest, per-phase spans, per-round cadence, "
+                        "counter snapshots); analyze with "
+                        "'fedtpu report <file>'")
     p.add_argument("--platform", choices=["default", "cpu"],
                    default="default",
                    help="force the JAX platform before backend init "
@@ -307,6 +312,9 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["pipelined_stop"] = True
     if getattr(args, "model_parallel", None) is not None:
         run_kw["model_parallel"] = args.model_parallel
+    if args.events is not None:
+        run_kw["telemetry"] = dataclasses.replace(run.telemetry,
+                                                  events_path=args.events)
     if run_kw:
         run = dataclasses.replace(run, **run_kw)
     return ExperimentConfig(data=data, shard=shard, model=model, optim=optim,
@@ -422,6 +430,21 @@ def build_parser() -> argparse.ArgumentParser:
                               help="sklearn warm-start limitation demo")
     _add_common_overrides(parity_p)
 
+    # Offline analysis of a --events sink: no preset, no backend — the
+    # report layer is numpy+stdlib only, so this works on any machine the
+    # log was copied to.
+    report_p = sub.add_parser("report",
+                              help="aggregate a telemetry events JSONL "
+                                   "(phase breakdown, round cadence, "
+                                   "staleness, counters)")
+    report_p.add_argument("events", help="events JSONL written via --events")
+    report_p.add_argument("--format", choices=["text", "json"],
+                          default="text",
+                          help="report rendering (default text)")
+    report_p.add_argument("--prometheus", default=None, metavar="PATH",
+                          help="also write a Prometheus text-exposition "
+                               "snapshot of the aggregated log here")
+
     sub.add_parser("presets", help="list shipped presets")
     return parser
 
@@ -434,6 +457,17 @@ def main(argv=None) -> int:
             print(f"{name}: clients={preset.shard.num_clients} "
                   f"model={preset.model.kind}{list(preset.model.hidden_sizes)} "
                   f"rounds={preset.fed.rounds} weighting={preset.fed.weighting}")
+        return 0
+
+    if args.cmd == "report":
+        # Before _apply_overrides: the report parser carries no --preset
+        # (and must not — it reads a log, not a config).
+        from fedtpu.telemetry.report import render_report
+        rendered, prom = render_report(args.events, fmt=args.format)
+        print(rendered)
+        if args.prometheus:
+            with open(args.prometheus, "w") as f:
+                f.write(prom)
         return 0
 
     if getattr(args, "platform", "default") == "cpu":

@@ -262,6 +262,19 @@ class FedConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Structured-telemetry knobs (fedtpu.telemetry): a versioned JSONL
+    event sink (spans, per-round cadence, counter snapshots — read back by
+    ``fedtpu report``), the startup run manifest, and the leveled logger's
+    threshold. All off-path when ``events_path`` is None: the run loop then
+    talks to a NullTracer and pays one no-op method call per event."""
+
+    events_path: Optional[str] = None    # JSONL sink; None = telemetry off
+    manifest: bool = True                # emit the run manifest event at start
+    log_level: str = "info"              # 'debug' | 'info' | 'warning'
+
+
+@dataclasses.dataclass(frozen=True)
 class RunConfig:
     """Host loop I/O: logging, checkpointing, timing, held-out eval."""
 
@@ -300,6 +313,8 @@ class RunConfig:
     # (fedtpu.parallel.tp): hidden weights shard over a tensor-parallel axis
     # of this extent. MLP only; partial participation unsupported there.
     model_parallel: int = 1
+    # Structured telemetry (span/event sink, manifest, logger level).
+    telemetry: TelemetryConfig = TelemetryConfig()
 
 
 @dataclasses.dataclass(frozen=True)

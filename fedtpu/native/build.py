@@ -10,6 +10,7 @@ import pathlib
 import shutil
 import subprocess
 import threading
+import warnings
 from typing import Optional
 
 _HERE = pathlib.Path(__file__).resolve().parent
@@ -38,7 +39,8 @@ def ensure_built(verbose: bool = False) -> Optional[pathlib.Path]:
             return None
         if proc.returncode != 0:
             if verbose:
-                print(f"[fedtpu.native] build failed:\n{proc.stderr}")
+                warnings.warn(f"[fedtpu.native] build failed:\n{proc.stderr}",
+                              RuntimeWarning, stacklevel=2)
             tmp.unlink(missing_ok=True)
             return None
         os.replace(tmp, _SO)

@@ -23,6 +23,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from fedtpu.telemetry import default_registry
 from fedtpu.utils.trees import identity, to_numpy
 
 
@@ -67,12 +68,23 @@ def save_checkpoint(directory: str, state, history: dict, step: int,
         state_item = to_numpy(state_item)
     ckptr.save(os.path.join(path, "state"), state_item, force=True)
     num_clients = jax.tree.leaves(state["params"])[0].shape[0]
+    # Engine kind as an int flag (orbax meta passes through np.asarray, so
+    # strings are off the table): the async engine's state carries its
+    # anchors pytree, the sync engines' never does. Read back by resume
+    # BEFORE the client-count comparison — a cross-engine resume must fail
+    # on engine kind, not on whichever structural mismatch orbax hits first.
+    engine_async = 1 if (isinstance(state, dict) and "anchors" in state) else 0
     meta = {"history": {k: np.asarray(v) for k, v in history.items()},
             "step": np.asarray(step),
-            "num_clients": np.asarray(num_clients)}
+            "num_clients": np.asarray(num_clients),
+            "engine_async": np.asarray(engine_async)}
     if extra_meta:
         meta.update({k: np.asarray(v) for k, v in extra_meta.items()})
     ckptr.save(os.path.join(path, "meta"), meta, force=True)
+    reg = default_registry()
+    reg.counter("checkpoint_saves").inc()
+    reg.counter("checkpoint_bytes_written").inc(
+        sum(getattr(l, "nbytes", 0) for l in jax.tree.leaves(state_item)))
     return path
 
 
@@ -194,6 +206,7 @@ def load_checkpoint_raw(directory: str, step: Optional[int] = None
     state = ckptr.restore(os.path.join(path, "state"))
     meta = ckptr.restore(os.path.join(path, "meta"))
     history = {k: list(np.asarray(v)) for k, v in meta["history"].items()}
+    default_registry().counter("checkpoint_restores").inc()
     return state, history, int(np.asarray(meta["step"]))
 
 
@@ -291,4 +304,5 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
         state["shared_start"] = ()
     history = {k: list(np.asarray(v))
                for k, v in meta["history"].items()}
+    default_registry().counter("checkpoint_restores").inc()
     return state, history, int(np.asarray(meta["step"]))

@@ -58,6 +58,10 @@ from fedtpu.orchestration.checkpoint import (complete_steps,
                                              save_checkpoint)
 from fedtpu.orchestration.privacy import PrivacyLedger
 from fedtpu.parallel.mesh import make_mesh, client_sharding
+from fedtpu.telemetry import (TelemetryLogger, build_manifest,
+                              default_registry, install_compile_probe,
+                              make_tracer)
+from fedtpu.telemetry.metrics import device_memory_gauges
 from fedtpu.parallel.round import (build_round_fn, build_eval_fn,
                                    init_federated_state, global_params)
 from fedtpu.utils.timing import Timer, force_fetch
@@ -296,6 +300,12 @@ def build_experiment(cfg: ExperimentConfig,
         if cfg.fed.scaffold:
             raise ValueError("async_mode does not support SCAFFOLD (its "
                              "variate refresh assumes lockstep rounds)")
+        if cfg.fed.personalize_steps > 0:
+            raise ValueError("async_mode does not support personalize_steps: "
+                             "post-training fine-tune starts every client "
+                             "from the final averaged global, but async "
+                             "client slots hold distinct (possibly stale) "
+                             "local models, not that global")
         if cfg.fed.aggregation != "psum":
             raise ValueError("async_mode uses the psum aggregation path "
                              "only")
@@ -496,9 +506,6 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     history) and continue the round loop from the saved round. Pooled /
     per-client / test histories restart at the resume point; the early-stop
     comparator re-seeds from the restored history's last entry."""
-    exp = build_experiment(cfg, dataset)
-    state, batch, eval_step, ds = exp.state, exp.batch, exp.eval_step, exp.dataset
-
     # Multi-process (multi-host) awareness — the reference runs its WHOLE
     # driver under `mpirun --hostfile`, so the whole loop must run under
     # jax.distributed too (tests/test_multihost_e2e.py runs it across two
@@ -508,16 +515,49 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     #     host-addressable; `_rep` re-lays a pytree out replicated (GSPMD
     #     inserts the cross-host all-gathers), which also keeps the
     #     early-stop/divergence control flow consensual on every process;
-    #   * print/JSONL side effects happen on process 0 only — but NOT
-    #     checkpoint writes: orbax save is a collective (every process must
-    #     call it or the job deadlocks in orbax's internal barrier), with
-    #     each process persisting the client shards it owns to the shared
-    #     checkpoint filesystem;
+    #   * print/JSONL/telemetry side effects happen on process 0 only —
+    #     non-zero processes get a NullTracer and a silent logger — but
+    #     NOT checkpoint writes: orbax save is a collective (every process
+    #     must call it or the job deadlocks in orbax's internal barrier),
+    #     with each process persisting the client shards it owns to the
+    #     shared checkpoint filesystem;
     #   * control flow (early stop, divergence, round counters) stays
     #     identical on every process because it is derived from the
     #     replicated metrics.
     multiproc = jax.process_count() > 1
     io_proc = jax.process_index() == 0
+    verbose = verbose and io_proc
+
+    tel = cfg.run.telemetry
+    tracer = make_tracer(tel.events_path if io_proc else None)
+    registry = default_registry()
+    registry.reset()
+    install_compile_probe()
+    log = TelemetryLogger(verbose=verbose, tracer=tracer,
+                          level=tel.log_level)
+
+    with tracer.span("build"):
+        exp = build_experiment(cfg, dataset)
+    state, batch, eval_step, ds = exp.state, exp.batch, exp.eval_step, exp.dataset
+
+    if tel.manifest:
+        tracer.event("manifest", **build_manifest(
+            cfg=cfg, mesh=exp.mesh,
+            extra={"program": "run",
+                   "engine": ("async" if cfg.fed.async_mode
+                              else "tp2d" if cfg.run.model_parallel > 1
+                              else "sync1d")}))
+    # Estimated exchange volume per round: every client ships one model's
+    # worth of floats through the aggregation (and receives the average
+    # back); int8 compression quarters the f32 payload. An estimate of the
+    # logical exchange, not a wire measurement — psum's actual traffic is
+    # XLA-scheduled.
+    model_bytes = sum(int(np.prod(l.shape[1:]) or 1) * l.dtype.itemsize
+                      for l in jax.tree.leaves(state["params"]))
+    registry.gauge("exchange_bytes_per_round_est").set(
+        model_bytes * cfg.shard.num_clients
+        // (4 if cfg.fed.compress == "int8" else 1))
+
     if multiproc:
         from fedtpu.parallel.mesh import replicated_sharding
         from fedtpu.utils.trees import identity
@@ -525,7 +565,6 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         # calls in one process hit the jit cache instead of retracing.
         _rep = jax.jit(identity,
                        out_shardings=replicated_sharding(exp.mesh))
-        verbose = verbose and io_proc
     else:
         _rep = lambda t: t
 
@@ -556,6 +595,25 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # restore below; only a count MISMATCH (or a pre-num_clients
             # checkpoint) pays the raw state read.
             restored_meta = load_meta(cfg.run.checkpoint_dir)
+            # Engine kind gate FIRST, from the meta item alone: a
+            # cross-engine resume at the SAME client count used to sail
+            # past the count comparison into the template restore, where
+            # orbax killed it with an opaque tree-structure diff. The
+            # saved flag (engine_async, written by save_checkpoint) names
+            # the real problem before any state is read; checkpoints from
+            # before the flag existed fall through to the structural
+            # check in the elastic path below.
+            saved_async = restored_meta.get("engine_async")
+            if saved_async is not None:
+                saved_async = bool(int(np.asarray(saved_async)))
+                if saved_async != ("anchors" in state):
+                    raise ValueError(
+                        "resume engine mismatch: the checkpoint was "
+                        f"written by the "
+                        f"{'async' if saved_async else 'synchronous'} "
+                        "engine but the current config selects the "
+                        "other; resume with the matching engine, or "
+                        "warm-start a fresh run from exported weights")
             nc = restored_meta.get("num_clients")
             saved_c = None if nc is None else int(np.asarray(nc))
             if saved_c is None:
@@ -570,9 +628,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # the 2-D engine's tensor-parallel layout survives resume.
                 state, restored_history, start_round = load_checkpoint(
                     cfg.run.checkpoint_dir, state_like=state)
-                if verbose:
-                    print(f"Resumed from checkpoint at round {start_round}.",
-                          flush=True)
+                log.info(f"Resumed from checkpoint at round {start_round}.")
             else:
                 if ("anchors" in state) != ("anchors" in raw):
                     # Engine mismatch either way: async state is NOT
@@ -614,16 +670,13 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     state["round"] = jnp.asarray(raw_round, jnp.int32)
                     dropped = float(np.asarray(raw.get("buf_count", 0.0)))
                     restored_history, start_round = raw_history, raw_round
-                    if verbose:
-                        buf_note = (f", {int(dropped)} pending buffered "
-                                    "updates dropped" if dropped > 0
-                                    else "")
-                        print(f"Async elastic resume at tick {raw_round}: "
-                              f"{saved_c} -> {cfg.shard.num_clients} "
-                              "clients (freshest-anchor global carried "
-                              "over, every client re-pulled, fresh "
-                              f"optimizer state{buf_note}).",
-                              flush=True)
+                    buf_note = (f", {int(dropped)} pending buffered "
+                                "updates dropped" if dropped > 0 else "")
+                    log.info(f"Async elastic resume at tick {raw_round}: "
+                             f"{saved_c} -> {cfg.shard.num_clients} "
+                             "clients (freshest-anchor global carried "
+                             "over, every client re-pulled, fresh "
+                             f"optimizer state{buf_note}).")
                 else:
                     # SYNC ELASTIC resume — the cluster grew or shrank
                     # (the reference cannot do this at all: client count
@@ -655,21 +708,22 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                             state["dp_clip"].sharding)
                     state["round"] = jnp.asarray(raw_round, jnp.int32)
                     restored_history, start_round = raw_history, raw_round
-                    if verbose:
-                        # Per-client SCAFFOLD variates are client-count-
-                        # shaped like the Adam moments: an elastic resume
-                        # restarts them at zero (invariant-consistent; the
-                        # correction re-warms over the next rounds) — say
-                        # so, or a drift study across a resume sees an
-                        # unexplained regression.
-                        cv_note = (", control variates reset to zero"
-                                   if "client_cv" in state else "")
-                        print(f"Elastic resume at round {raw_round}: "
-                              f"{saved_num_clients(raw)} -> "
-                              f"{cfg.shard.num_clients} clients (global "
-                              "model carried over, fresh client optimizer "
-                              f"state{cv_note}).",
-                              flush=True)
+                    # Per-client SCAFFOLD variates are client-count-
+                    # shaped like the Adam moments: an elastic resume
+                    # restarts them at zero (invariant-consistent; the
+                    # correction re-warms over the next rounds) — say
+                    # so, or a drift study across a resume sees an
+                    # unexplained regression.
+                    cv_note = (", control variates reset to zero"
+                               if "client_cv" in state else "")
+                    log.info(f"Elastic resume at round {raw_round}: "
+                             f"{saved_num_clients(raw)} -> "
+                             f"{cfg.shard.num_clients} clients (global "
+                             "model carried over, fresh client optimizer "
+                             f"state{cv_note}).")
+
+    if restored_history is not None:
+        tracer.event("resume", round=start_round)
 
     # DP RDP bookkeeping lives in its own module (fedtpu.orchestration.
     # privacy): the cumulative per-order RDP curve is the resumable
@@ -715,8 +769,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         label always matches the saved state even when the history ends at
         the earlier divergent round."""
         nonlocal stopped_early, diverged
-        if verbose:
-            print(f"Non-finite {reason}; halting (diverged run).", flush=True)
+        log.warning(f"Non-finite {reason}; halting (diverged run).")
+        tracer.event("diverged", round=label_round, reason=reason)
         if cfg.run.checkpoint_dir:
             # All processes reach here together (the decision derives from
             # replicated metrics/state) and all must call the save — orbax
@@ -814,6 +868,15 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             metrics = jax.tree.map(np.asarray, metrics)
             per_round = _unstack_metrics(metrics, take)
             dt = timer.lap() / take
+            # The chunk span closes HERE, on the np.asarray materialization
+            # above — the fetch-forced-completion rule (block_until_ready
+            # does not synchronize on the axon transport; the host fetch is
+            # the proof the chunk's device work finished).
+            tracer.event("span", phase="chunk", round=rnd0 + take,
+                         dur_s=dt * take, rounds=take)
+            # Host-side decision window (history/log/early-stop); ended at
+            # every exit of the loop below — Span.end is idempotent.
+            sp_stop = tracer.span("stop_check", round=rnd0 + take)
 
             for j, m in enumerate(per_round):
                 r = rnd0 + j
@@ -822,6 +885,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 losses.append(np.asarray(m["loss"]))
                 sec_per_round.append(dt)
                 rounds_run = r + 1
+                loss_mean = float(np.mean(losses[-1]))
 
                 for k in METRIC_NAMES:
                     history[k].append(client_mean[k])
@@ -830,12 +894,27 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 if "staleness" in m:        # async engine's extra metric
                     staleness_hist.append(np.asarray(m["staleness"]))
 
+                registry.counter("rounds").inc()
+                tracer.event("round", round=r + 1, dur_s=dt,
+                             accuracy=client_mean["accuracy"],
+                             loss_mean=loss_mean,
+                             **({"staleness_mean":
+                                 float(staleness_hist[-1].mean()),
+                                 "staleness_max":
+                                 float(staleness_hist[-1].max())}
+                                if "staleness" in m else {}))
+                if "staleness" in m:
+                    from fedtpu.parallel.async_fed import \
+                        record_tick_telemetry
+                    record_tick_telemetry(registry, tracer, r + 1,
+                                          staleness_hist[-1])
+
                 if jsonl is not None:
                     jsonl.write(json.dumps({
                         "round": r + 1, "sec_per_round": dt,
                         "client_mean": client_mean,
                         "pooled": {k: pooled_hist[k][-1] for k in METRIC_NAMES},
-                        "loss_mean": float(np.mean(losses[-1])),
+                        "loss_mean": loss_mean,
                         **({"staleness_mean":
                             float(staleness_hist[-1].mean())}
                            if "staleness" in m else {}),
@@ -843,23 +922,25 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     jsonl.flush()
 
                 if verbose and (r % cfg.run.log_every == 0):
-                    print(f"\nRound {r + 1}:\n", flush=True)
+                    log.parity(f"\nRound {r + 1}:\n")
                     if cfg.run.log_per_client:
                         # Parity with the barrier-serialized rank-ordered prints
                         # (FL_CustomMLP...:151-162) — here just a loop, no barriers.
                         for c in range(cfg.shard.num_clients):
                             vals = ", ".join(f"{k}: {per_client[k][c]:.4f}"
                                              for k in METRIC_NAMES)
-                            print(f"  CLIENT {c} - Local Metrics (Round {r + 1}): "
-                                  f"[{vals}]", flush=True)
+                            log.parity(f"  CLIENT {c} - Local Metrics "
+                                       f"(Round {r + 1}): [{vals}]")
                     gvals = ", ".join(f"{k}: {client_mean[k]:.4f}"
                                       for k in METRIC_NAMES)
                     stale_note = (f"  (mean staleness "
                                   f"{staleness_hist[-1].mean():.2f})"
                                   if "staleness" in m else "")
-                    print(f"  Global Metrics (Round {r + 1}): [{gvals}]  "
-                          f"({dt * 1e3:.1f} ms/round){stale_note}",
-                          flush=True)
+                    # parity, not info: the line is reference-shaped and
+                    # must never grow a prefix; its timing suffix is what
+                    # keeps it out of the byte-identity tests.
+                    log.parity(f"  Global Metrics (Round {r + 1}): [{gvals}]  "
+                               f"({dt * 1e3:.1f} ms/round){stale_note}")
 
                 # Failure detection: a diverged step (NaN/inf loss or
                 # metrics) halts cleanly instead of burning the remaining
@@ -870,6 +951,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                         and np.all(np.isfinite(losses[-1]))):
                     halt_diverged(f"loss/metrics at round {r + 1}",
                                   state_round)
+                    sp_stop.end()
                     return
 
                 # Early stopping — exact reference logic (FL_CustomMLP...:181-192).
@@ -877,24 +959,25 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                         cur, prev_metric, atol=cfg.fed.tolerance):
                     termination_count -= 1
                     if termination_count == 0:
-                        if verbose:
-                            print("Early stopping triggered: No significant "
-                                  "change in metrics for "
-                                  f"{cfg.fed.termination_patience} rounds.",
-                                  flush=True)
-                            if r + 1 < cfg.fed.rounds:
-                                # The reference's break-iteration message
-                                # (FL_CustomMLP...:135): its loop re-enters
-                                # round r+1 (0-indexed == this r+1) and
-                                # breaks before training; printed only when
-                                # there IS a next round to break out of.
-                                print(f"Training stopped early at round "
-                                      f"{r + 1}.", flush=True)
+                        log.parity("Early stopping triggered: No significant "
+                                   "change in metrics for "
+                                   f"{cfg.fed.termination_patience} rounds.")
+                        if r + 1 < cfg.fed.rounds:
+                            # The reference's break-iteration message
+                            # (FL_CustomMLP...:135): its loop re-enters
+                            # round r+1 (0-indexed == this r+1) and
+                            # breaks before training; printed only when
+                            # there IS a next round to break out of.
+                            log.parity(f"Training stopped early at round "
+                                       f"{r + 1}.")
+                        tracer.event("early_stop", round=r + 1)
                         stopped_early = True
+                        sp_stop.end()
                         return
                 else:
                     prev_metric = cur
                     termination_count = cfg.fed.termination_patience
+            sp_stop.end()
 
         # Pipelined-stop mode (cfg.run.pipelined_stop): dispatch chunk k+1
         # BEFORE processing chunk k's metrics, so the per-chunk host work
@@ -923,7 +1006,16 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         rnd = start_round
         while rnd < cfg.fed.rounds and not stopped_early:
             take = min(chunk, cfg.fed.rounds - rnd)
-            state, metrics = get_step(take)(state, batch)
+            if take not in step_fns:
+                # First call at this chunk width: trace + lower + compile
+                # happen synchronously inside the dispatch (only execution
+                # is async), so the span brackets the compile cost. The
+                # jax.monitoring probe (install_compile_probe) counts the
+                # backend-reported compile seconds alongside.
+                with tracer.span("compile", round=rnd + take, rounds=take):
+                    state, metrics = get_step(take)(state, batch)
+            else:
+                state, metrics = get_step(take)(state, batch)
             if pipelined:
                 if pending is not None:
                     # The current `state` is the just-dispatched chunk's
@@ -986,8 +1078,13 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # _rep: the global slice of a client-sharded array is not
                 # host-addressable from every process; replicated params
                 # also make the eval jit's output fetchable everywhere.
+                sp = tracer.span("eval", round=rnd)
                 tm = eval_step(_rep(exp.global_fn(state)),
                                ds.x_test, ds.y_test)
+                # Span closes on the host fetch of the eval metrics — the
+                # fetch-forced-completion rule again.
+                sp.end_after_fetch(tm)
+                registry.counter("held_out_evals").inc()
                 for _ in range(eval_due):
                     for k in METRIC_NAMES:
                         test_hist[k].append(float(tm[k]))
@@ -1005,9 +1102,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # collective (barriers internally — a process-0-only call
                 # deadlocks), and it writes each client shard from the
                 # process that owns it (true distributed checkpointing).
-                save_checkpoint(cfg.run.checkpoint_dir, state, history, rnd,
-                                extra_meta=ledger.checkpoint_meta(rnd))
-                retain_after_save(rnd)
+                with tracer.span("checkpoint", round=rnd):
+                    save_checkpoint(cfg.run.checkpoint_dir, state, history,
+                                    rnd,
+                                    extra_meta=ledger.checkpoint_meta(rnd))
+                    retain_after_save(rnd)
 
         if pending is not None and not stopped_early:
             process_chunk(*pending, state_round=rnd)
@@ -1042,6 +1141,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             jax.profiler.stop_trace()
         if jsonl is not None:
             jsonl.close()
+        # Final counter snapshot even on the failure path — the sink exists
+        # to diagnose exactly such runs. Memory gauges are best-effort
+        # (buffers may already be deleted mid-failure).
+        device_memory_gauges(registry)
+        tracer.counters(registry.snapshot())
 
     personalized: Dict[str, dict] = {}
     if exp.personalize_fn is not None and not diverged:
@@ -1057,11 +1161,10 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             "client_mean": {k: float(v)
                             for k, v in pm["client_mean"].items()},
         }
-        if verbose:
-            vals = ", ".join(f"{k}: {v:.4f}"
-                             for k, v in personalized["client_mean"].items())
-            print(f"Personalized ({cfg.fed.personalize_steps} local steps) "
-                  f"client-mean: [{vals}]", flush=True)
+        vals = ", ".join(f"{k}: {v:.4f}"
+                         for k, v in personalized["client_mean"].items())
+        log.info(f"Personalized ({cfg.fed.personalize_steps} local steps) "
+                 f"client-mean: [{vals}]")
 
     result = ExperimentResult(
         global_metrics=history,
@@ -1089,7 +1192,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         result, dp_rdp_total=ledger.rdp_at(result.rounds_trained),
         dp_guarantee_void=ledger.void_at(result.rounds_trained),
         dp_composed=ledger.composed)
-    if verbose:
+    if verbose or tracer.enabled:
         dp = result.privacy_spent()
         if dp:
             notes = ""
@@ -1098,9 +1201,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                           "shown are the current segment's")
             if dp.get("guarantee_void"):
                 notes += f"; GUARANTEE VOID: {dp['guarantee_void']}"
-            print(f"DP budget spent: epsilon={dp['epsilon']:.3f} at "
-                  f"delta={dp['delta']:.1e} (noise multiplier "
-                  f"{dp['noise_multiplier']}, sampling rate "
-                  f"{dp['sampling_rate']}, {dp['rounds']} rounds; RDP "
-                  f"order {dp['rdp_order']}{notes})", flush=True)
+            log.info(f"DP budget spent: epsilon={dp['epsilon']:.3f} at "
+                     f"delta={dp['delta']:.1e} (noise multiplier "
+                     f"{dp['noise_multiplier']}, sampling rate "
+                     f"{dp['sampling_rate']}, {dp['rounds']} rounds; RDP "
+                     f"order {dp['rdp_order']}{notes})")
+    tracer.event("run_end", round=rounds_run, stopped_early=stopped_early,
+                 diverged=diverged, rounds_trained=result.rounds_trained)
+    tracer.close()
     return result

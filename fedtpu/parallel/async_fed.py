@@ -47,6 +47,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
@@ -55,6 +56,22 @@ from fedtpu.parallel.round import (assemble_metrics, bcast_global,
                                    client_init_keys)
 from fedtpu.training.client import (make_local_eval_step,
                                     make_local_train_step)
+
+
+def record_tick_telemetry(registry, tracer, tick: int, staleness) -> None:
+    """Fold one tick's (C,) staleness vector into the metrics registry
+    (tick counter, staleness histogram, last-mean gauge) and emit the
+    per-tick ``async_tick`` event. Called by the host round loop on the
+    ALREADY-FETCHED numpy staleness — no device sync here; pure host
+    bookkeeping shared so the loop and any external driver agree on what
+    an async tick records."""
+    s = np.ravel(np.asarray(staleness, dtype=np.float64))
+    registry.counter("async_ticks").inc()
+    registry.histogram("staleness").observe_many(s)
+    mean = float(s.mean()) if s.size else 0.0
+    registry.gauge("staleness_last_mean").set(mean)
+    tracer.event("async_tick", round=tick, staleness_mean=mean,
+                 staleness_max=float(s.max()) if s.size else 0.0)
 
 
 def init_async_state(key: jax.Array, mesh, num_clients: int,

@@ -33,6 +33,7 @@ from fedtpu.data.sharding import shard_indices
 from fedtpu.data import load_dataset
 from fedtpu.data.tabular import Dataset
 from fedtpu.ops.metrics import METRIC_NAMES
+from fedtpu.telemetry import TelemetryLogger
 
 
 def _sklearn_metrics(y_true, y_pred) -> dict:
@@ -56,6 +57,7 @@ def run_sklearn_rounds(ds: Dataset, cfg: ExperimentConfig,
     global weights."""
     from sklearn.neural_network import MLPClassifier
 
+    log = TelemetryLogger(verbose=verbose)
     idx = shard_indices(ds.y_train, cfg.shard)
     shards = [(ds.x_train[i], ds.y_train[i]) for i in idx]
     classes = np.unique(ds.y_train)
@@ -100,10 +102,8 @@ def run_sklearn_rounds(ds: Dataset, cfg: ExperimentConfig,
         # weights are identical if averaging truly has no effect.
         fit_fingerprints.append(float(sum(np.abs(w).sum()
                                           for w in models[0].coefs_)))
-        if verbose:
-            print(f"[sklearn] round {rnd + 1}: pooled "
-                  + ", ".join(f"{k}={pooled[k]:.4f}" for k in METRIC_NAMES),
-                  flush=True)
+        log.info(f"[sklearn] round {rnd + 1}: pooled "
+                 + ", ".join(f"{k}={pooled[k]:.4f}" for k in METRIC_NAMES))
 
     # Final "Global Weight Statistics" report — per-layer shape/mean/std of
     # the final global weights (FL_SkLearn_MLPClassifier_Limitation.py:
@@ -113,11 +113,13 @@ def run_sklearn_rounds(ds: Dataset, cfg: ExperimentConfig,
                      "mean": float(np.mean(w)),
                      "std": float(np.std(w))}
                     for w in (global_weights or [])]
-    if verbose and weight_stats:
-        print("\nFinal Global Weight Statistics:")
+    if weight_stats:
+        # Reference-parity lines — byte-identical to the reference output,
+        # so they go through log.parity (never reformatted, never leveled).
+        log.parity("\nFinal Global Weight Statistics:")
         for idx, st in enumerate(weight_stats):
-            print(f"Layer {idx + 1} - Shape: {tuple(st['shape'])}")
-            print(f"Mean: {st['mean']:.6f}, Std: {st['std']:.6f}")
+            log.parity(f"Layer {idx + 1} - Shape: {tuple(st['shape'])}")
+            log.parity(f"Mean: {st['mean']:.6f}, Std: {st['std']:.6f}")
 
     fp = np.asarray(fit_fingerprints)
     return {

@@ -66,6 +66,8 @@ from fedtpu.models.mlp import mlp_init, mlp_apply
 from fedtpu.ops.losses import masked_cross_entropy
 from fedtpu.ops.metrics import confusion_matrix, metrics_from_confusion
 from fedtpu.parallel.mesh import CLIENTS_AXIS, make_mesh, client_sharding
+from fedtpu.telemetry import (MetricsRegistry, TelemetryLogger,
+                              build_manifest, make_tracer)
 
 # hyperparameters_tuning.py:73-74, verbatim grid.
 HIDDEN_GRID = ((50,), (100,), (50, 50), (100, 50), (50, 100), (50, 200),
@@ -277,8 +279,22 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     float drift). Each table row carries ``in_tie_set``."""
     hidden_grid = HIDDEN_GRID if hidden_grid is None else hidden_grid
     lr_grid = LR_GRID if lr_grid is None else lr_grid
+    tel = cfg.run.telemetry
+    tracer = make_tracer(tel.events_path)
+    # The sweep keeps its OWN registry (not default_registry): a sweep that
+    # warm-starts run_experiment launches — or one driven alongside a
+    # training run — must not have its counters wiped by the run loop's
+    # per-run reset.
+    registry = MetricsRegistry()
+    log = TelemetryLogger(verbose=verbose, tracer=tracer,
+                          level=tel.log_level)
     ds = dataset or load_dataset(cfg.data)
     mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
+    if tel.manifest:
+        tracer.event("manifest", **build_manifest(
+            cfg=cfg, mesh=mesh,
+            extra={"program": "sweep",
+                   "grid_size": len(hidden_grid) * len(lr_grid)}))
     shard = client_sharding(mesh)
     packed = pack_clients(ds.x_train, ds.y_train, cfg.shard)
     x = jax.device_put(packed.x, shard)
@@ -320,6 +336,9 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     results: dict = {}
     for n_launch, (archs, lr_group) in enumerate(launches):
         l = len(lr_group)
+        sp_launch = tracer.span("launch", round=n_launch + 1,
+                                architectures=len(archs),
+                                learning_rates=l)
         bucket = (_bucket_shape(archs[0], hidden_grid) if bucket_pad
                   else tuple(archs[0]))
         slabs = []
@@ -370,10 +389,14 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     "win": w,
                 }
         del avg_params, conf, pooled_conf
-        if verbose:
-            print(f"  launch {n_launch + 1}/{len(launches)} done "
-                  f"({len(archs)} architectures x {l} learning rates)",
-                  flush=True)
+        # np.asarray on pooled/weights above already materialized the
+        # launch's outputs on host (the fetch-forced completion proof), so
+        # the span closes on finished device work.
+        sp_launch.end(launch_max_accuracy=float(pooled["accuracy"].max()))
+        registry.counter("sweep_launches").inc()
+        registry.counter("sweep_configs").inc(len(archs) * l)
+        log.info(f"  launch {n_launch + 1}/{len(launches)} done "
+                 f"({len(archs)} architectures x {l} learning rates)")
 
     # ---- reporting in REFERENCE grid order (hidden outer, lr inner), so
     # the first-hit strict-> argmax is launch-plan-independent.
@@ -388,10 +411,9 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                           "learning_rate": float(lr),
                           "mean_local_steps": row["mean_local_steps"],
                           **metrics})
-            if verbose:
-                print(f"  grid [{hidden} lr={lr}]: "
-                      f"acc={metrics['accuracy']:.4f} "
-                      f"f1={metrics['f1']:.4f}", flush=True)
+            log.info(f"  grid [{hidden} lr={lr}]: "
+                     f"acc={metrics['accuracy']:.4f} "
+                     f"f1={metrics['f1']:.4f}")
             if metrics["accuracy"] > best["accuracy"]:
                 best = {
                     "accuracy": metrics["accuracy"],
@@ -403,10 +425,16 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     # The strict-> scan's final winner is the first grid-order row at the
     # global max — which is its own launch's first-at-max slot, the one
     # slot per launch whose weights were materialized above.
-    best["weights"] = results[
-        (tuple(best["params"]["hidden_layer_sizes"]),
-         best["params"]["learning_rate"])]["win"]
+    winner_key = (tuple(best["params"]["hidden_layer_sizes"]),
+                  best["params"]["learning_rate"])
+    best["weights"] = results[winner_key]["win"]
     assert best["weights"] is not None
+    # Every launch materialized its first-at-max slot's weights above;
+    # now that the grid-order winner is known, the non-winning copies are
+    # dead — drop them so a 2-launch sweep holds ONE model's weights from
+    # here on instead of one per launch for the rest of the call (and,
+    # with keep_weights=False, of the caller's hold on the return value).
+    _drop_nonwinning_weights(results, winner_key)
 
     # ---- tie set: the stable answer (VERDICT r4 next #3). Strict-> picks
     # ONE of these depending on ulp drift between compiled programs; the
@@ -422,16 +450,18 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                             "learning_rate": row["learning_rate"],
                             "accuracy": row["accuracy"]})
 
-    if verbose:
-        print("\nBest Global Hyperparameters:", best["params"])
-        print(f"Best Global Metrics: {best['metrics']}")
-        if len(tie_set) > 1:
-            print(f"Tie set ({len(tie_set)} configs within "
-                  f"{tie_tolerance:g} of accuracy {top:.4f} — the strict-> "
-                  "winner above is one arbitrary member):")
-            for t in tie_set:
-                print(f"  {t['hidden_layer_sizes']} "
-                      f"lr={t['learning_rate']}", flush=True)
+    # The two winner lines are the reference's own report
+    # (hyperparameters_tuning.py:126-129) — parity output, byte-identical
+    # to the former two-arg print form.
+    log.parity(f"\nBest Global Hyperparameters: {best['params']}")
+    log.parity(f"Best Global Metrics: {best['metrics']}")
+    if len(tie_set) > 1:
+        log.info(f"Tie set ({len(tie_set)} configs within "
+                 f"{tie_tolerance:g} of accuracy {top:.4f} — the strict-> "
+                 "winner above is one arbitrary member):")
+        for t in tie_set:
+            log.info(f"  {t['hidden_layer_sizes']} "
+                     f"lr={t['learning_rate']}")
     weights = best["weights"] if keep_weights else best.pop("weights")
     best["weight_shapes"] = ([list(lyr["w"].shape) for lyr in weights["layers"]]
                              if weights else [])
@@ -445,7 +475,24 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         best["compile_count"] = int(sweep_fn._cache_size())
     except Exception:
         best["compile_count"] = None
+    tracer.counters(registry.snapshot())
+    tracer.event("sweep_end", best_accuracy=best["accuracy"],
+                 launch_count=best["launch_count"],
+                 tie_set_size=len(tie_set))
+    tracer.close()
     return best
+
+
+def _drop_nonwinning_weights(results: dict, winner_key) -> int:
+    """Null out the materialized ``win`` weights of every non-winning row
+    (each launch eagerly kept one candidate's weights; only the grid-order
+    winner's survive). Returns how many copies were dropped."""
+    dropped = 0
+    for key, row in results.items():
+        if key != winner_key and row.get("win") is not None:
+            row["win"] = None
+            dropped += 1
+    return dropped
 
 
 def save_best_weights(path: str, best: dict) -> None:

@@ -1,0 +1,34 @@
+"""Structured observability for fedtpu (ISSUE 1).
+
+The reference has zero observability — ``print(flush=True)`` only
+(SURVEY.md §5) — and until this subsystem fedtpu had two disconnected
+islands: fetch-forced wall-clock timing (fedtpu.utils.timing) and a
+schemaless per-round metrics JSONL. This package unifies them:
+
+    trace     — span/event tracer writing a versioned JSONL event sink
+                (monotonic timestamps; device spans close on host
+                materialization, never on dispatch — the repo's
+                fetch-forced-completion rule)
+    metrics   — process-local counters / gauges / histograms, plus the
+                jax.monitoring compile-event probe
+    manifest  — the startup run manifest (config dump + hash, mesh shape,
+                device kinds, backend, package version, git rev) so every
+                artifact is attributable
+    log       — the leveled logger that byte-preserves the reference-parity
+                output lines while mirroring everything else into the sink
+    report    — offline aggregation of an events JSONL into per-phase time
+                breakdowns, round-cadence percentiles, staleness
+                distributions and counter totals (``fedtpu report``);
+                numpy-only so it runs without a JAX backend
+
+Everything here is import-light: no module in this package imports jax at
+import time (probes that need it import lazily), so ``fedtpu report`` and
+the tests' synthetic round-trips run without touching a backend.
+"""
+
+from fedtpu.telemetry.trace import (EVENT_SCHEMA_VERSION, NullTracer,  # noqa: F401
+                                    Tracer, make_tracer)
+from fedtpu.telemetry.metrics import (MetricsRegistry, default_registry,  # noqa: F401
+                                      install_compile_probe)
+from fedtpu.telemetry.log import TelemetryLogger  # noqa: F401
+from fedtpu.telemetry.manifest import build_manifest  # noqa: F401

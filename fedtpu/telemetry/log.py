@@ -1,0 +1,58 @@
+"""Leveled logger that byte-preserves the reference-parity output lines.
+
+Two output classes, one object:
+
+  * ``parity(msg)`` — the reference-faithful lines (the doomed-iteration
+    early-stop message, the barrier-ordered per-client prints, the sweep's
+    winner report). Printed byte-for-byte via ``print(msg, flush=True)``
+    and NEVER reformatted, prefixed, or redirected — an A/B diff against
+    the reference's stdout must stay clean with telemetry on or off.
+  * ``info(msg)`` / ``warning(msg)`` / ``debug(msg)`` — fedtpu's own
+    operational lines. Printed when the level allows AND mirrored into the
+    event sink (kind ``log``) so a quiet run still records what happened.
+
+This module and ``fedtpu/cli.py`` are the ONLY places in ``fedtpu/``
+allowed to call bare ``print`` — enforced by the tier-1 lint test
+(tests/test_telemetry.py); everything else routes through here.
+
+Verbosity composes the caller's ``verbose`` flag with the multi-process
+rule (side effects on process 0 only): the round loop constructs the
+logger after folding ``io_proc`` into ``verbose``, so non-zero processes
+stay silent without call-site guards.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_LEVELS = {"debug": 10, "info": 20, "warning": 30}
+
+
+class TelemetryLogger:
+    def __init__(self, verbose: bool = True, tracer=None,
+                 level: str = "info"):
+        self.verbose = verbose
+        self._tracer = tracer
+        self._threshold = _LEVELS.get(level, 20)
+
+    def _emit(self, level: str, msg: str) -> None:
+        if self.verbose and _LEVELS[level] >= self._threshold:
+            print(msg, flush=True)
+        if self._tracer is not None:
+            self._tracer.event("log", level=level, msg=msg)
+
+    def debug(self, msg: str) -> None:
+        self._emit("debug", msg)
+
+    def info(self, msg: str) -> None:
+        self._emit("info", msg)
+
+    def warning(self, msg: str) -> None:
+        self._emit("warning", msg)
+
+    def parity(self, msg: str) -> None:
+        """Reference-parity line: byte-exact stdout, no sink mirror, no
+        level filtering beyond the verbose gate (the reference prints these
+        unconditionally; ``--quiet`` maps to ``verbose=False``)."""
+        if self.verbose:
+            print(msg, flush=True)

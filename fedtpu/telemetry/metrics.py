@@ -1,0 +1,184 @@
+"""Process-local counters / gauges / histograms + the compile-event probe.
+
+A ``MetricsRegistry`` is plain host-side bookkeeping — no locks (the round
+loop is single-threaded per process), no jax at import time. The round
+loop resets the default registry at run start, increments it as the run
+progresses (rounds trained, checkpoint saves/restores, async ticks,
+staleness observations, estimated bytes exchanged), and emits
+``registry.snapshot()`` as a ``counters`` event so ``fedtpu report`` can
+total everything offline.
+
+``install_compile_probe`` hooks ``jax.monitoring``'s event-duration stream
+(the channel jax itself reports backend compile times on) into the DEFAULT
+registry: every ``*compil*`` event increments ``jax_compile_events`` and
+adds its duration to ``jax_compile_secs``. Registered once per process —
+jax keeps listeners forever, so re-registration would double-count.
+
+Histogram buckets are cumulative-style upper bounds (Prometheus ``le``
+semantics) so the report's Prometheus export is a direct rendering.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+# Upper bounds for the staleness histogram: async staleness is a small
+# non-negative integer (ticks since last pull), heavy-tailed under low
+# arrival rates — powers of two cover the studyable range.
+DEFAULT_STALENESS_BINS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus ``le`` upper bounds) with
+    running count/sum/min/max. ``bucket_counts[i]`` counts observations
+    ``<= bins[i]``; one implicit +Inf bucket equals ``count``."""
+
+    __slots__ = ("bins", "bucket_counts", "count", "sum", "min", "max")
+
+    def __init__(self, bins: Sequence[float] = DEFAULT_STALENESS_BINS):
+        self.bins = tuple(float(b) for b in bins)
+        self.bucket_counts = [0] * len(self.bins)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        for i, b in enumerate(self.bins):
+            if v <= b:
+                self.bucket_counts[i] += 1
+
+    def observe_many(self, values) -> None:
+        for v in values:
+            self.observe(v)
+
+    def to_dict(self) -> dict:
+        return {"bins": list(self.bins),
+                "bucket_counts": list(self.bucket_counts),
+                "count": self.count, "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+                "mean": (self.sum / self.count) if self.count else None}
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str,
+                  bins: Optional[Sequence[float]] = None) -> Histogram:
+        if name not in self._histograms:
+            self._histograms[name] = Histogram(
+                bins if bins is not None else DEFAULT_STALENESS_BINS)
+        return self._histograms[name]
+
+    def snapshot(self) -> dict:
+        """JSON-ready view — the payload of a ``counters`` event."""
+        return {
+            "counters": {k: c.value for k, c in self._counters.items()},
+            "gauges": {k: g.value for k, g in self._gauges.items()},
+            "histograms": {k: h.to_dict()
+                           for k, h in self._histograms.items()},
+        }
+
+    def reset(self) -> None:
+        """Clear all instruments IN PLACE — the registry object's identity
+        survives (the compile probe holds a reference across runs)."""
+        self._counters.clear()
+        self._gauges.clear()
+        self._histograms.clear()
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The per-process registry the run loop / checkpoint layer share."""
+    return _DEFAULT
+
+
+_COMPILE_PROBE_INSTALLED = False
+
+
+def install_compile_probe() -> bool:
+    """Route jax's compile-event durations into the default registry
+    (counters ``jax_compile_events`` / ``jax_compile_secs``). Idempotent:
+    jax.monitoring listeners are registered for the process lifetime and
+    cannot be removed, so only the first call installs. Returns whether a
+    probe is installed (False when this jax build lacks the API)."""
+    global _COMPILE_PROBE_INSTALLED
+    if _COMPILE_PROBE_INSTALLED:
+        return True
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        # Event names are jax-internal paths ('/jax/core/compile',
+        # backend_compile...); match loosely, never raise into jax.
+        try:
+            if "compil" in event:
+                reg = default_registry()
+                reg.counter("jax_compile_events").inc()
+                reg.counter("jax_compile_secs").inc(float(duration))
+        except Exception:
+            pass
+
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _COMPILE_PROBE_INSTALLED = True
+    return True
+
+
+def device_memory_gauges(registry: Optional[MetricsRegistry] = None) -> None:
+    """Best-effort device-memory gauges from the live backend:
+    ``live_array_count`` / ``live_array_bytes`` (jax.live_arrays) and
+    ``device_bytes_in_use`` (PJRT memory_stats, where the backend reports
+    it — CPU does not). Never raises: telemetry must not kill the run it
+    observes, and mid-failure some buffers may already be deleted."""
+    reg = registry if registry is not None else default_registry()
+    try:
+        import jax
+        arrays = [a for a in jax.live_arrays() if not a.is_deleted()]
+        reg.gauge("live_array_count").set(len(arrays))
+        reg.gauge("live_array_bytes").set(
+            sum(getattr(a, "nbytes", 0) for a in arrays))
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "bytes_in_use" in stats:
+            reg.gauge("device_bytes_in_use").set(stats["bytes_in_use"])
+    except Exception:
+        pass

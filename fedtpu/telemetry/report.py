@@ -1,0 +1,237 @@
+"""Offline aggregation of a telemetry events JSONL (``fedtpu report``).
+
+Reconstructs — from the event log ALONE, no run state needed — the
+per-phase time breakdown, round-cadence percentiles, staleness
+distribution, and counter/gauge totals, rendered as text, JSON, or a
+Prometheus text-exposition snapshot for scraping.
+
+numpy + stdlib only: ``fedtpu report`` must work on a machine with no JAX
+backend (the log was produced on a TPU host; the analysis runs anywhere).
+Unknown event kinds and newer schema versions degrade to a warning line,
+never a crash — logs outlive the code that wrote them.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from fedtpu.telemetry.trace import EVENT_SCHEMA_VERSION
+
+
+def load_events(path: str) -> Tuple[List[dict], int]:
+    """Parse a JSONL sink; returns (events, malformed_line_count). A
+    truncated final line (crash mid-write) is counted, not fatal."""
+    events, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(rec, dict) and "kind" in rec:
+                events.append(rec)
+            else:
+                bad += 1
+    return events, bad
+
+
+def _percentiles(durs: List[float]) -> dict:
+    a = np.asarray(durs, dtype=np.float64)
+    return {"p50_s": float(np.percentile(a, 50)),
+            "p90_s": float(np.percentile(a, 90)),
+            "p99_s": float(np.percentile(a, 99)),
+            "mean_s": float(a.mean()),
+            "max_s": float(a.max())}
+
+
+def aggregate(events: List[dict], malformed: int = 0) -> dict:
+    """One pass over the events into the report dict (see module
+    docstring). Counter/gauge/histogram totals come from the LAST
+    ``counters`` event — each is a full registry snapshot, so the last one
+    is the run's final tally."""
+    phases: dict = {}
+    round_durs: List[float] = []
+    round_max = 0
+    stale_means: List[float] = []
+    manifest = None
+    last_counters = None
+    run_ids = []
+    newer_schema = 0
+    for e in events:
+        v = e.get("v")
+        if isinstance(v, int) and v > EVENT_SCHEMA_VERSION:
+            newer_schema += 1
+        rid = e.get("run_id")
+        if rid and rid not in run_ids:
+            run_ids.append(rid)
+        kind = e.get("kind")
+        payload = e.get("payload") or {}
+        if kind == "span" and e.get("phase"):
+            p = phases.setdefault(e["phase"],
+                                  {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            d = float(e.get("dur_s") or 0.0)
+            p["count"] += 1
+            p["total_s"] += d
+            p["max_s"] = max(p["max_s"], d)
+        elif kind == "round":
+            round_durs.append(float(e.get("dur_s") or 0.0))
+            if e.get("round"):
+                round_max = max(round_max, int(e["round"]))
+            if payload.get("staleness_mean") is not None:
+                stale_means.append(float(payload["staleness_mean"]))
+        elif kind == "manifest":
+            manifest = payload
+        elif kind == "counters":
+            last_counters = payload
+
+    out: dict = {
+        "events_total": len(events),
+        "malformed_lines": malformed,
+        "newer_schema_events": newer_schema,
+        "run_ids": run_ids,
+        "manifest": None,
+        "phases": {k: {**v, "mean_s": v["total_s"] / v["count"]}
+                   for k, v in sorted(phases.items())},
+        "rounds": {"count": len(round_durs), "last_round": round_max},
+        "staleness": None,
+        "counters": {}, "gauges": {}, "histograms": {},
+    }
+    if manifest:
+        out["manifest"] = {k: manifest.get(k) for k in
+                           ("config_hash", "package_version", "jax_version",
+                            "backend", "device_count", "device_kinds",
+                            "mesh_shape", "git_rev", "process_count",
+                            "program", "engine")
+                           if manifest.get(k) is not None}
+    if round_durs:
+        out["rounds"]["total_s"] = float(np.sum(round_durs))
+        out["rounds"]["cadence"] = _percentiles(round_durs)
+    if last_counters:
+        out["counters"] = dict(last_counters.get("counters") or {})
+        out["gauges"] = dict(last_counters.get("gauges") or {})
+        out["histograms"] = dict(last_counters.get("histograms") or {})
+    hist = out["histograms"].get("staleness")
+    if hist or stale_means:
+        out["staleness"] = {
+            **({"count": hist["count"], "mean": hist["mean"],
+                "min": hist["min"], "max": hist["max"],
+                "bins": hist["bins"],
+                "bucket_counts": hist["bucket_counts"]} if hist else {}),
+            **({"round_mean_of_means": float(np.mean(stale_means))}
+               if stale_means else {}),
+        }
+    return out
+
+
+def render_text(agg: dict) -> str:
+    lines = ["fedtpu telemetry report",
+             f"  events: {agg['events_total']}"
+             + (f" ({agg['malformed_lines']} malformed lines skipped)"
+                if agg["malformed_lines"] else "")]
+    if agg.get("newer_schema_events"):
+        lines.append(f"  warning: {agg['newer_schema_events']} events carry "
+                     f"a schema newer than v{EVENT_SCHEMA_VERSION} — "
+                     "fields this reader doesn't know are ignored")
+    if agg.get("run_ids"):
+        lines.append(f"  run_id: {', '.join(agg['run_ids'])}")
+    man = agg.get("manifest")
+    if man:
+        lines.append("  manifest: " + ", ".join(
+            f"{k}={man[k]}" for k in sorted(man)))
+    ph = agg.get("phases") or {}
+    if ph:
+        lines.append("phase breakdown:")
+        width = max(len(k) for k in ph)
+        for k, v in sorted(ph.items(), key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"  {k:<{width}}  total {v['total_s']:9.3f} s  "
+                         f"x{v['count']:<5d} mean {v['mean_s']:.4f} s  "
+                         f"max {v['max_s']:.4f} s")
+    rounds = agg.get("rounds") or {}
+    if rounds.get("count"):
+        c = rounds.get("cadence") or {}
+        lines.append(f"rounds: {rounds['count']} "
+                     f"(last round {rounds.get('last_round')}, "
+                     f"total {rounds.get('total_s', 0.0):.3f} s)")
+        if c:
+            lines.append(f"  cadence p50 {c['p50_s']:.4f} s  "
+                         f"p90 {c['p90_s']:.4f} s  p99 {c['p99_s']:.4f} s  "
+                         f"mean {c['mean_s']:.4f} s  max {c['max_s']:.4f} s")
+    st = agg.get("staleness")
+    if st:
+        if st.get("count"):
+            lines.append(f"staleness: {st['count']} observations, "
+                         f"mean {st['mean']:.3f}, min {st['min']:.0f}, "
+                         f"max {st['max']:.0f}")
+            lines.append("  histogram (<= bound: count): " + ", ".join(
+                f"{b:g}: {n}" for b, n in zip(st["bins"],
+                                              st["bucket_counts"])))
+        elif st.get("round_mean_of_means") is not None:
+            lines.append(f"staleness: mean-of-round-means "
+                         f"{st['round_mean_of_means']:.3f}")
+    if agg.get("counters"):
+        lines.append("counters:")
+        for k, v in sorted(agg["counters"].items()):
+            lines.append(f"  {k} = {v:g}")
+    if agg.get("gauges"):
+        lines.append("gauges:")
+        for k, v in sorted(agg["gauges"].items()):
+            lines.append(f"  {k} = {v:g}")
+    return "\n".join(lines)
+
+
+def _prom_name(name: str) -> str:
+    return "fedtpu_" + "".join(c if c.isalnum() or c == "_" else "_"
+                               for c in name)
+
+
+def render_prometheus(agg: dict) -> str:
+    """Prometheus text-exposition snapshot of the aggregated log — a file
+    a textfile-collector / pushgateway setup can scrape as-is."""
+    lines: List[str] = []
+
+    def emit(name, value, typ, labels=""):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} {typ}")
+        lines.append(f"{n}{labels} {value:g}")
+
+    for k, v in sorted((agg.get("counters") or {}).items()):
+        emit(k + "_total", v, "counter")
+    for k, v in sorted((agg.get("gauges") or {}).items()):
+        emit(k, v, "gauge")
+    for k, v in sorted((agg.get("phases") or {}).items()):
+        emit(f"phase_{k}_seconds_total", v["total_s"], "counter")
+        emit(f"phase_{k}_spans_total", v["count"], "counter")
+    cadence = (agg.get("rounds") or {}).get("cadence")
+    if cadence:
+        for q, key in (("0.5", "p50_s"), ("0.9", "p90_s"),
+                       ("0.99", "p99_s")):
+            n = _prom_name("round_duration_seconds")
+            lines.append(f'{n}{{quantile="{q}"}} {cadence[key]:g}')
+    for name, h in sorted((agg.get("histograms") or {}).items()):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} histogram")
+        for b, c in zip(h["bins"], h["bucket_counts"]):
+            lines.append(f'{n}_bucket{{le="{b:g}"}} {c}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{n}_sum {h['sum']:g}")
+        lines.append(f"{n}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def render_report(path: str, fmt: str = "text") -> Tuple[str, str]:
+    """CLI entry: returns (rendered report in ``fmt``, Prometheus text).
+    Both derive from one aggregation pass over the log."""
+    events, bad = load_events(path)
+    agg = aggregate(events, malformed=bad)
+    if fmt == "json":
+        rendered = json.dumps(agg, indent=2, sort_keys=True)
+    else:
+        rendered = render_text(agg)
+    return rendered, render_prometheus(agg)

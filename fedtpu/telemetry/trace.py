@@ -1,0 +1,187 @@
+"""Span/event tracer with a versioned JSONL sink.
+
+Event schema (``EVENT_SCHEMA_VERSION = 1``) — one JSON object per line:
+
+    v        int    schema version
+    run_id   str    one uuid4 hex per tracer (joins every event of a run)
+    kind     str    'manifest' | 'span' | 'round' | 'counters' | 'log' | ...
+    phase    str?   span phase label ('build', 'compile', 'chunk', 'eval',
+                    'checkpoint', 'stop_check', 'personalize', 'launch', ...)
+    round    int?   1-based round (tick) the event belongs to, when any
+    t_start  float  seconds since the tracer's epoch (time.monotonic-based,
+                    so deltas are immune to wall-clock steps)
+    dur_s    float  span duration; 0.0 for instantaneous events
+    payload  dict   kind-specific data (metric values, counter snapshots...)
+
+Timing rule, inherited from fedtpu.utils.timing's round-1 postmortem:
+``jax.block_until_ready`` does NOT synchronize on this platform's remote
+('axon') transport, so a device span must close on a HOST VALUE FETCH
+(``force_fetch`` / ``np.asarray`` materialization), never on dispatch.
+``Span.end_after_fetch`` packages that rule; the round loop closes its
+chunk spans on the batched metrics materialization, which is the same
+proof.
+
+Writes flush per event: a crashed run's sink still holds everything
+emitted before the crash (the tracer exists precisely to diagnose such
+runs), so ``close()`` is a nicety, not a durability requirement.
+
+No jax import at module scope — the reader side (fedtpu.telemetry.report)
+and the tests' synthetic emitters must work backend-free.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Optional
+
+EVENT_SCHEMA_VERSION = 1
+
+
+class Span:
+    """One open phase window; created by ``Tracer.span``. Usable as a
+    context manager (closes on ``__exit__``) or manually via ``end`` /
+    ``end_after_fetch``."""
+
+    def __init__(self, tracer: "Tracer", phase: str,
+                 round: Optional[int] = None, **payload):
+        self._tracer = tracer
+        self.phase = phase
+        self.round = round
+        self.payload = dict(payload)
+        self._t0 = time.monotonic()
+        self._closed = False
+
+    def end(self, **extra) -> float:
+        """Close the span (idempotent) and emit it; returns the duration."""
+        dur = time.monotonic() - self._t0
+        if not self._closed:
+            self._closed = True
+            self._tracer.event("span", phase=self.phase, round=self.round,
+                               dur_s=dur, **{**self.payload, **extra})
+        return dur
+
+    def end_after_fetch(self, tree, **extra) -> float:
+        """Close the span on a host value fetch of ``tree`` — the
+        fetch-forced-completion rule (module docstring). The fetch is the
+        proof the device work inside the span actually finished."""
+        from fedtpu.utils.timing import force_fetch
+        force_fetch(tree)
+        return self.end(**extra)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end(**({"error": repr(exc)} if exc is not None else {}))
+
+
+class Tracer:
+    """Appends schema-v1 events to a JSONL sink. One per run; all
+    timestamps are seconds since this tracer's construction (monotonic)."""
+
+    def __init__(self, path: str, run_id: Optional[str] = None):
+        self.path = path
+        self.run_id = run_id or uuid.uuid4().hex
+        self._epoch = time.monotonic()
+        self._f = open(path, "a")
+
+    @property
+    def enabled(self) -> bool:
+        return True
+
+    def _now(self) -> float:
+        return time.monotonic() - self._epoch
+
+    def event(self, kind: str, phase: Optional[str] = None,
+              round: Optional[int] = None, dur_s: float = 0.0,
+              t_start: Optional[float] = None, **payload) -> None:
+        """Emit one event. ``t_start`` defaults to now minus ``dur_s`` so a
+        caller that timed a window itself (the round loop's chunk lap) gets
+        an honest window start without threading timestamps around."""
+        if self._f.closed:
+            return
+        rec = {"v": EVENT_SCHEMA_VERSION, "run_id": self.run_id,
+               "kind": kind, "phase": phase, "round": round,
+               "t_start": (self._now() - dur_s if t_start is None
+                           else t_start),
+               "dur_s": dur_s, "payload": payload}
+        self._f.write(json.dumps(rec, default=_json_default) + "\n")
+        self._f.flush()
+
+    def span(self, phase: str, round: Optional[int] = None,
+             **payload) -> Span:
+        return Span(self, phase, round=round, **payload)
+
+    def counters(self, snapshot: dict) -> None:
+        """Emit a full registry snapshot (kind 'counters'). The report's
+        counter totals come from the LAST such event in the log."""
+        self.event("counters", **snapshot)
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+
+class _NullSpan:
+    phase = None
+    round = None
+    payload: dict = {}
+
+    def end(self, **extra) -> float:
+        return 0.0
+
+    def end_after_fetch(self, tree, **extra) -> float:
+        return 0.0
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        pass
+
+
+class NullTracer:
+    """Telemetry-off tracer: same surface as ``Tracer``, every call a
+    no-op. The round loop is written against this API unconditionally, so
+    the disabled path costs a method call per event, not a branch per
+    call site."""
+
+    path = None
+    run_id = None
+
+    @property
+    def enabled(self) -> bool:
+        return False
+
+    def event(self, kind, phase=None, round=None, dur_s=0.0, t_start=None,
+              **payload) -> None:
+        pass
+
+    def span(self, phase, round=None, **payload) -> _NullSpan:
+        return _NullSpan()
+
+    def counters(self, snapshot) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def _json_default(obj):
+    """Sink-side coercion for numpy scalars/arrays and other non-JSON
+    payload leaves — the tracer must never crash the run it observes."""
+    for attr in ("item",):
+        if hasattr(obj, attr) and getattr(obj, "ndim", None) == 0:
+            return obj.item()
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    return repr(obj)
+
+
+def make_tracer(path: Optional[str], run_id: Optional[str] = None):
+    """The one constructor call sites use: a real ``Tracer`` when ``path``
+    is set (process 0 of a run), a ``NullTracer`` otherwise."""
+    return Tracer(path, run_id=run_id) if path else NullTracer()

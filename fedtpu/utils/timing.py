@@ -80,7 +80,10 @@ def compile_with_flops(step, *args):
     analysis is unavailable: a benchmark that cannot check its flops floor
     must not record a number at all."""
     compiled = step.lower(*args).compile()
-    flops = float((compiled.cost_analysis() or {}).get("flops", 0.0))
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):   # pre-0.5 jax: list of per-module dicts
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", 0.0))
     if flops <= 0:
         raise RuntimeError(
             "XLA cost_analysis unavailable for this program; the flops "

@@ -142,6 +142,11 @@ QUICK_TESTS = {
     "test_tp.py::test_unsupported_combos_raise",
     "test_tp.py::test_per_device_state_bytes_scale_down_with_tp",
     # round-4 modules
+    # telemetry subsystem (tracer/report/satellites; backend-free picks)
+    "test_telemetry.py::test_event_schema_roundtrip",
+    "test_telemetry.py::test_bench_json_is_last_stdout_line",
+    "test_telemetry.py::test_drop_nonwinning_weights_frees_losers",
+    "test_telemetry.py::test_no_bare_prints_outside_allowlist",
     "test_scaffold.py::test_server_cv_is_mean_of_client_cv",
     "test_scaffold.py::test_incompatible_combos_raise",
     "test_adaptive_clip.py::test_effective_delta_noise_multiplier_identity",

@@ -174,7 +174,8 @@ def test_every_documented_flag_exists_in_the_parser():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     documented = set()
     for rel in ("README.md", "docs/API.md", "docs/ARCHITECTURE.md",
-                "PARITY.md", "benchmarks/RESULTS.md"):
+                "docs/observability.md", "PARITY.md",
+                "benchmarks/RESULTS.md"):
         text = open(os.path.join(root, rel)).read()
         # Underscores ARE captured so `--dp_clip_norm`-style typos show up
         # as unknown flags instead of silently failing to match.
@@ -182,6 +183,7 @@ def test_every_documented_flag_exists_in_the_parser():
             r"(?<![\w/-])(--[a-z][a-z0-9_-]+)(?![a-z0-9_-])", text))
     # Flags documented for OTHER executables, not fedtpu.cli.
     other_tools = {"--reps",                       # benchmarks/*.py
+                   "--out",                        # bench.py result file
                    "--eval-every",                 # accuracy_parity.py
                    "--xla_force_host_platform_device_count",  # XLA flag
                    "--hostfile", "--np"}           # mpirun (reference docs)
