@@ -1,0 +1,33 @@
+"""Static analysis + runtime guards for fedtpu's jit/shard_map-heavy code.
+
+Two halves:
+
+    engine / rules_* / reporters — an AST rule engine (``fedtpu lint``):
+        FTP001  host sync (float()/.item()/np.asarray) in traced code
+        FTP002  PRNG key reuse without split/fold_in
+        FTP003  donation hazards (use-after-donate; missing donate_argnums
+                on state-threading jitted steps)
+        FTP004  Python branching on tracer values
+        FTP005  bare print() outside the telemetry output layer
+        FTP101  mutable default arguments
+        FTP102  broad except that swallows all errors
+        Suppress per line with ``# fedtpu: noqa[FTP001] <justification>``.
+
+    guards — runtime complements (``fedtpu check``): a ``guards()``
+        context manager scoping jax.transfer_guard / jax_debug_nans, and
+        ``RecompileSentinel``, which counts backend compiles during
+        steady-state round-stepping (after warmup that count must be 0).
+
+The lint half never imports jax; the guard half imports it lazily.  See
+docs/analysis.md for the rule catalog.
+"""
+
+from fedtpu.analysis.engine import (Finding, LintResult, RULES,  # noqa: F401
+                                    lint_paths, lint_source)
+# Importing the rule modules registers every FTP checker, so lint_source
+# works directly for any importer of the package (not just lint_paths,
+# which also imports them lazily).
+from fedtpu.analysis import rules_generic, rules_jax  # noqa: F401
+from fedtpu.analysis.guards import (RecompileSentinel, RetraceError,  # noqa: F401
+                                    guards)
+from fedtpu.analysis.reporters import render_json, render_text  # noqa: F401

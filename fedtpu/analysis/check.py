@@ -1,0 +1,86 @@
+"""The ``fedtpu check`` driver: prove the round step is retrace-free.
+
+Builds a small synthetic experiment, compiles the round step once
+(warmup), then re-steps it under :func:`fedtpu.analysis.guards.guards`
+with an armed :class:`RecompileSentinel`.  A steady-state round loop
+must hit the compilation cache on every post-warmup call — any compile
+observed while armed is an unexpected retrace (dtype drift, weak-type
+promotion, a python value baked into the trace changing...), the exact
+failure mode that silently multiplies round latency on TPU.
+
+The check runs the *real* engine path (``build_experiment`` →
+``make_step``), not a toy model, so a retrace regression in
+``parallel/round.py`` or ``parallel/tp.py`` fails here before it costs
+accelerator time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from fedtpu.analysis.guards import RecompileSentinel, guards
+
+
+def run_check(
+    *,
+    preset: str = "income-8",
+    rounds: int = 4,
+    transfer: str = "log",
+    nans: bool = False,
+    synthetic_rows: int = 512,
+    registry=None,
+) -> dict:
+    """Run the retrace/transfer check; returns a JSON-serializable report.
+
+    ``recompiles`` is the armed-window backend-compile count — 0 means the
+    steady-state step is cache-stable.  ``ok`` folds that plus sentinel
+    availability into a single gate bit.
+    """
+    import jax
+
+    from fedtpu.config import get_preset
+    from fedtpu.orchestration.loop import build_experiment
+
+    cfg = get_preset(preset)
+    # Force the small synthetic dataset: the check probes compilation
+    # behavior, not accuracy, and must run in seconds on any host.
+    cfg = dataclasses.replace(
+        cfg,
+        data=dataclasses.replace(
+            cfg.data,
+            csv_path=None,
+            dataset_name=None,
+            synthetic_rows=synthetic_rows,
+        ),
+    )
+
+    exp = build_experiment(cfg)
+    step = exp.make_step(1)
+
+    # Warmup: the one expected compile happens here, outside the armed
+    # window.
+    state, metrics = step(exp.state, exp.batch)
+    jax.block_until_ready(metrics)
+
+    sentinel = RecompileSentinel(
+        label=f"round_step[{preset}]", registry=registry
+    )
+    with guards(transfer=transfer, nans=nans, sentinel=sentinel):
+        for _ in range(rounds):
+            state, metrics = step(state, exp.batch)
+        # Completion proof inside the armed window: execution (not just
+        # dispatch) must be retrace-free.
+        jax.block_until_ready(metrics)
+
+    return {
+        "preset": preset,
+        "rounds": rounds,
+        "transfer_guard": transfer,
+        "debug_nans": nans,
+        "sentinel_available": sentinel.available,
+        "recompiles": sentinel.count,
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "ok": bool(sentinel.available and sentinel.count == 0),
+    }

@@ -1,0 +1,221 @@
+"""Rule engine for fedtpu's static analysis.
+
+The engine is deliberately small: a rule is a callable ``(tree, src, path)
+-> iterable[Finding]`` registered under an FTP code.  ``lint_source`` runs
+the selected rules over one module and applies per-line suppressions;
+``lint_paths`` walks directories and aggregates.
+
+Suppression syntax (one line, next to the finding)::
+
+    np.asarray(x)  # fedtpu: noqa[FTP001] metrics fetch happens off the hot path
+
+The justification text after the closing bracket is free-form but expected;
+``fedtpu lint`` reports suppressions so reviewers can audit them.
+
+This module must stay importable without jax — ``fedtpu lint`` runs in
+environments (CI lint gates, pre-commit) where pulling in a backend is
+wasteful.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Callable, Iterable, Sequence
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Rule",
+    "RULES",
+    "rule",
+    "lint_source",
+    "lint_paths",
+    "iter_python_files",
+]
+
+_NOQA_RE = re.compile(r"#\s*fedtpu:\s*noqa\[([A-Z0-9,\s]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def sort_key(self) -> tuple:
+        return (self.path, self.line, self.col, self.rule)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    doc: str
+    check: Callable[[ast.AST, str, str], Iterable[Finding]]
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(code: str, name: str, doc: str):
+    """Register a checker under ``code``.  Used as a decorator."""
+
+    def deco(fn: Callable[[ast.AST, str, str], Iterable[Finding]]):
+        if code in RULES:
+            raise ValueError(f"duplicate rule code {code}")
+        RULES[code] = Rule(code=code, name=name, doc=doc, check=fn)
+        return fn
+
+    return deco
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding] = dataclasses.field(default_factory=list)
+    suppressed: list[Finding] = dataclasses.field(default_factory=list)
+    files_checked: int = 0
+    parse_errors: list[Finding] = dataclasses.field(default_factory=list)
+
+    def merge(self, other: "LintResult") -> None:
+        self.findings.extend(other.findings)
+        self.suppressed.extend(other.suppressed)
+        self.parse_errors.extend(other.parse_errors)
+        self.files_checked += other.files_checked
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+
+def _noqa_codes_by_line(src: str) -> dict[int, set[str]]:
+    """Map 1-based line number -> set of FTP codes suppressed on that line."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = _NOQA_RE.search(text)
+        if m:
+            codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+            out[i] = codes
+    return out
+
+
+def _selected_rules(
+    select: Sequence[str] | None, ignore: Sequence[str] | None
+) -> list[Rule]:
+    codes = sorted(RULES)
+    if select:
+        wanted = set(select)
+        unknown = wanted - set(codes)
+        if unknown:
+            raise ValueError(f"unknown rule code(s): {sorted(unknown)}")
+        codes = [c for c in codes if c in wanted]
+    if ignore:
+        unknown = set(ignore) - set(RULES)
+        if unknown:
+            raise ValueError(f"unknown rule code(s): {sorted(unknown)}")
+        codes = [c for c in codes if c not in set(ignore)]
+    return [RULES[c] for c in codes]
+
+
+def lint_source(
+    src: str,
+    path: str = "<string>",
+    *,
+    select: Sequence[str] | None = None,
+    ignore: Sequence[str] | None = None,
+) -> LintResult:
+    """Lint one module's source text.  Import-light and jax-free."""
+    result = LintResult(files_checked=1)
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        result.parse_errors.append(
+            Finding(
+                rule="FTP000",
+                path=path,
+                line=exc.lineno or 1,
+                col=(exc.offset or 1) - 1,
+                message=f"syntax error: {exc.msg}",
+            )
+        )
+        return result
+
+    noqa = _noqa_codes_by_line(src)
+    seen: set[tuple[str, str, int, int]] = set()
+    for r in _selected_rules(select, ignore):
+        for f in r.check(tree, src, path):
+            # Nested traced functions can surface the same site twice with
+            # slightly different messages; report each location once per rule.
+            key = (f.rule, f.path, f.line, f.col)
+            if key in seen:
+                continue
+            seen.add(key)
+            if f.rule in noqa.get(f.line, ()):
+                result.suppressed.append(f)
+            else:
+                result.findings.append(f)
+    result.findings.sort(key=Finding.sort_key)
+    result.suppressed.sort(key=Finding.sort_key)
+    return result
+
+
+def iter_python_files(paths: Sequence[str | Path]) -> list[Path]:
+    """Expand files/directories into a sorted, de-duplicated .py file list."""
+    seen: set[Path] = set()
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            candidates = sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            candidates = [p]
+        else:
+            candidates = []
+        for c in candidates:
+            if "__pycache__" in c.parts:
+                continue
+            rc = c.resolve()
+            if rc in seen:
+                continue
+            seen.add(rc)
+            out.append(c)
+    return out
+
+
+def lint_paths(
+    paths: Sequence[str | Path],
+    *,
+    select: Sequence[str] | None = None,
+    ignore: Sequence[str] | None = None,
+) -> LintResult:
+    """Lint every .py file under ``paths`` (files or directories)."""
+    # Importing the rule modules registers the checkers; deferred so that
+    # engine import alone never drags rule deps in the wrong order.
+    from fedtpu.analysis import rules_generic, rules_jax  # noqa: F401
+
+    total = LintResult()
+    for f in iter_python_files(paths):
+        try:
+            src = f.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as exc:
+            total.parse_errors.append(
+                Finding(
+                    rule="FTP000",
+                    path=str(f),
+                    line=1,
+                    col=0,
+                    message=f"unreadable: {exc}",
+                )
+            )
+            total.files_checked += 1
+            continue
+        total.merge(lint_source(src, str(f), select=select, ignore=ignore))
+    total.findings.sort(key=Finding.sort_key)
+    total.suppressed.sort(key=Finding.sort_key)
+    return total

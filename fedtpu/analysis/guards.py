@@ -1,0 +1,162 @@
+"""Runtime guards: transfer-guard wiring + a recompile sentinel.
+
+Static rules catch what the AST shows; this module catches what only the
+runtime shows.  ``guards()`` scopes jax's transfer guard (and optionally
+``jax_debug_nans``) over a block, and ``RecompileSentinel`` counts
+backend compilations while armed — after warmup, a steady-state round
+loop should compile exactly zero times, so any armed-window compile is
+an unexpected retrace (dtype drift, weak-type promotion, shape change,
+a python default flipping a static argument...).
+
+jax.monitoring listeners live for the whole process and cannot be
+removed, so — same pattern as ``install_compile_probe`` — one listener
+is registered once and dispatches to whichever sentinels are currently
+armed.  Counting keys on ``backend_compile`` events specifically: jax
+emits several ``*compil*`` duration events per compilation (jaxpr trace,
+MLIR lowering, backend compile) and we want one increment per actual
+compile.
+
+jax is imported lazily so that importing :mod:`fedtpu.analysis` (e.g.
+for ``fedtpu lint``) never drags in a backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+from fedtpu.telemetry.metrics import MetricsRegistry, default_registry
+
+__all__ = ["RecompileSentinel", "guards", "RetraceError"]
+
+# One increment per actual compilation; the broader '*compil*' family
+# double-counts (trace + lowering + backend events per compile).
+_BACKEND_COMPILE_MARKER = "backend_compile"
+
+_LISTENER_INSTALLED = False
+_ARMED: list["RecompileSentinel"] = []
+
+
+class RetraceError(RuntimeError):
+    """An armed RecompileSentinel observed unexpected compilations."""
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    try:
+        if _BACKEND_COMPILE_MARKER in event:
+            for sentinel in _ARMED:
+                sentinel._count += 1
+                if sentinel.registry is not None:
+                    sentinel.registry.counter("unexpected_retraces").inc()
+    except Exception:  # fedtpu: noqa[FTP102] never raise into jax's monitoring dispatch
+        pass
+
+
+def _install_listener() -> bool:
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _LISTENER_INSTALLED = True
+    return True
+
+
+class RecompileSentinel:
+    """Counts backend compiles observed while armed.
+
+    Usage::
+
+        sentinel = RecompileSentinel(label="round_step")
+        step(state, batch)          # warmup: compile happens here, uncounted
+        with sentinel.armed():
+            for _ in range(rounds):
+                state, m = step(state, batch)
+        assert sentinel.count == 0  # or fail=True to raise on exit
+
+    ``fail=True`` raises :class:`RetraceError` when the armed block exits
+    with a nonzero count — the tests' mode.  Counting into ``registry``
+    (``unexpected_retraces`` counter) is how production runs surface it
+    through telemetry instead.
+    """
+
+    def __init__(
+        self,
+        *,
+        label: str = "step",
+        registry: Optional[MetricsRegistry] = None,
+        fail: bool = False,
+    ):
+        self.label = label
+        self.registry = registry if registry is not None else default_registry()
+        self.fail = fail
+        self.available = _install_listener()
+        self._count = 0
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def reset(self) -> None:
+        self._count = 0
+
+    def arm(self) -> None:
+        if self not in _ARMED:
+            _ARMED.append(self)
+
+    def disarm(self) -> None:
+        if self in _ARMED:
+            _ARMED.remove(self)
+
+    @contextlib.contextmanager
+    def armed(self) -> Iterator["RecompileSentinel"]:
+        self.arm()
+        try:
+            yield self
+        finally:
+            self.disarm()
+            if self.fail and self._count:
+                raise RetraceError(
+                    f"{self._count} unexpected recompile(s) of `{self.label}` "
+                    "while armed — steady-state calls should hit the "
+                    "compilation cache (check dtypes, weak types, static args)"
+                )
+
+    def check(self) -> None:
+        """Raise RetraceError if any compiles were observed."""
+        if self._count:
+            raise RetraceError(
+                f"{self._count} unexpected recompile(s) of `{self.label}`"
+            )
+
+
+@contextlib.contextmanager
+def guards(
+    *,
+    transfer: str = "log",
+    nans: bool = False,
+    sentinel: Optional[RecompileSentinel] = None,
+) -> Iterator[Optional[RecompileSentinel]]:
+    """Scope jax runtime guards over a block.
+
+    transfer: jax.transfer_guard level — "allow", "log", "disallow" (and
+        jax's finer-grained variants).  "log" is the production default:
+        the metrics fetch at chunk boundaries is a *deliberate* transfer,
+        so hard-disallow belongs in tests, not the round loop.
+    nans: opt into jax_debug_nans for the block (restored on exit).
+    sentinel: arm this RecompileSentinel for the duration of the block.
+    """
+    import jax
+
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(jax.transfer_guard(transfer))
+        if nans:
+            prev = jax.config.jax_debug_nans
+            jax.config.update("jax_debug_nans", True)
+            stack.callback(jax.config.update, "jax_debug_nans", prev)
+        if sentinel is not None:
+            stack.enter_context(sentinel.armed())
+        yield sentinel

@@ -1,0 +1,58 @@
+"""Text and JSON reporters for lint results.
+
+Both reporters return strings; the CLI owns the actual write so this
+module stays side-effect free (and trivially golden-testable).
+"""
+
+from __future__ import annotations
+
+import json
+
+from fedtpu.analysis.engine import RULES, Finding, LintResult
+
+REPORT_SCHEMA_VERSION = 1
+
+
+def _fmt(f: Finding) -> str:
+    return f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}"
+
+
+def render_text(result: LintResult, *, show_suppressed: bool = False) -> str:
+    lines: list[str] = []
+    for f in result.parse_errors:
+        lines.append(_fmt(f))
+    for f in result.findings:
+        lines.append(_fmt(f))
+    if show_suppressed:
+        for f in result.suppressed:
+            lines.append(f"{_fmt(f)} [suppressed]")
+    n = len(result.findings) + len(result.parse_errors)
+    summary = (
+        f"{n} finding{'s' if n != 1 else ''}, "
+        f"{len(result.suppressed)} suppressed, "
+        f"{result.files_checked} file{'s' if result.files_checked != 1 else ''} checked"
+    )
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def _finding_dict(f: Finding) -> dict:
+    return {
+        "rule": f.rule,
+        "path": f.path,
+        "line": f.line,
+        "col": f.col,
+        "message": f.message,
+    }
+
+
+def render_json(result: LintResult) -> str:
+    payload = {
+        "schema_version": REPORT_SCHEMA_VERSION,
+        "clean": result.clean,
+        "files_checked": result.files_checked,
+        "findings": [_finding_dict(f) for f in result.findings + result.parse_errors],
+        "suppressed": [_finding_dict(f) for f in result.suppressed],
+        "rules": {code: RULES[code].doc for code in sorted(RULES)},
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)

@@ -1,0 +1,119 @@
+"""Generic (non-JAX) rules: FTP005, FTP101, FTP102.
+
+FTP005 absorbs the bare-print lint that used to live inline in
+``tests/test_telemetry.py``: telemetry output must flow through
+``TelemetryLogger`` / ``Tracer`` so that parity and event streams stay
+byte-stable, so ``print`` is only allowed in the two modules that *are*
+the output layer.  Test worker scripts that speak a stdout protocol to a
+parent process suppress per-line with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from fedtpu.analysis.engine import Finding, rule
+
+# Modules whose whole point is writing to stdout.  Matched by path suffix so
+# both "fedtpu/cli.py" and "/abs/path/fedtpu/cli.py" hit.
+PRINT_ALLOWLIST: tuple[str, ...] = (
+    "fedtpu/telemetry/log.py",
+    "fedtpu/cli.py",
+    "bench.py",
+)
+
+
+def _path_allowlisted(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return any(norm.endswith(suffix) for suffix in PRINT_ALLOWLIST)
+
+
+@rule(
+    "FTP005",
+    "bare-print",
+    "print() outside the telemetry output layer; route through "
+    "TelemetryLogger/Tracer so logs stay parseable and parity-stable.",
+)
+def check_bare_print(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
+    if _path_allowlisted(path):
+        return
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            yield Finding(
+                rule="FTP005",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message="bare print(); use the telemetry logger "
+                "(fedtpu/telemetry/log.py) or a Tracer event",
+            )
+
+
+@rule(
+    "FTP101",
+    "mutable-default-arg",
+    "Mutable default argument ([]/{} / set()) shared across calls.",
+)
+def check_mutable_default(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]
+        for d in defaults:
+            bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and isinstance(d.func, ast.Name)
+                and d.func.id in {"list", "dict", "set"}
+                and not d.args
+                and not d.keywords
+            )
+            if bad:
+                yield Finding(
+                    rule="FTP101",
+                    path=path,
+                    line=d.lineno,
+                    col=d.col_offset,
+                    message="mutable default argument is shared across calls; "
+                    "default to None and construct inside the body",
+                )
+
+
+def _is_pass_only(body: list[ast.stmt]) -> bool:
+    return all(isinstance(s, ast.Pass) for s in body) or (
+        len(body) == 1
+        and isinstance(body[0], ast.Expr)
+        and isinstance(body[0].value, ast.Constant)
+        and body[0].value.value is Ellipsis
+    )
+
+
+@rule(
+    "FTP102",
+    "except-swallow",
+    "Bare `except:` or `except Exception:` whose body only passes — "
+    "silently eats errors including tracer leaks and XLA failures.",
+)
+def check_except_swallow(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in {"Exception", "BaseException"}
+        )
+        if broad and _is_pass_only(node.body):
+            yield Finding(
+                rule="FTP102",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message="broad except swallows all errors; narrow the "
+                "exception type, log it, or justify with a noqa",
+            )

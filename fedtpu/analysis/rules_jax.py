@@ -1,0 +1,675 @@
+"""JAX-aware rules: FTP001-FTP004.
+
+All four rules hang off the same module-level reachability analysis: a
+function is *traced* if it is decorated with (or passed to) a JAX
+transform — ``jit``, ``shard_map``, ``vmap``, ``pmap``, ``lax.scan``,
+``lax.cond`` & co — or is called by bare name from another traced
+function in the same module.  Host-side helpers (e.g. the metrics fetch
+path in ``orchestration/loop.py``) never enter the traced set, so
+``float()`` / ``np.asarray`` there is not flagged.
+
+These are heuristics over a single module's AST: no cross-module call
+graph, no type inference.  They are tuned to the idioms in this repo
+(state dicts threaded through donated jitted steps, fold_in-per-round
+PRNG discipline) and every rule supports ``# fedtpu: noqa[...]`` for the
+cases the heuristic cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable
+
+from fedtpu.analysis.engine import Finding, rule
+
+# Terminal attribute names that mean "this callable's argument is traced".
+_TRANSFORM_NAMES = {
+    "jit",
+    "shard_map",
+    "vmap",
+    "pmap",
+    "grad",
+    "value_and_grad",
+    "scan",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "switch",
+    "checkpoint",
+    "remat",
+}
+
+# jax.random.* callables that *produce* or *derive* keys rather than
+# consuming them for sampling.
+_KEY_PRODUCERS = {"key", "PRNGKey", "split", "fold_in", "wrap_key_data", "clone"}
+
+_HOST_SYNC_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _attr_chain(node: ast.expr) -> list[str]:
+    """``jax.lax.scan`` -> ["jax", "lax", "scan"]; non-chains -> []."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _is_transform(node: ast.expr) -> bool:
+    """Does this expression denote a JAX transform callable?"""
+    chain = _attr_chain(node)
+    if not chain:
+        return False
+    if len(chain) == 1:
+        # Bare name: only trust it if it is an unambiguous transform name.
+        return chain[0] in {"jit", "shard_map", "vmap", "pmap", "scan"}
+    return chain[-1] in _TRANSFORM_NAMES and chain[0] in {"jax", "lax", "nn"}
+
+
+def _transform_of_decorator(dec: ast.expr) -> ast.expr | None:
+    """Unwrap a decorator down to the transform expression, if any.
+
+    Handles ``@jax.jit``, ``@jax.jit(...)`` and
+    ``@partial(jax.jit, donate_argnums=(0,))``.
+    """
+    if _is_transform(dec):
+        return dec
+    if isinstance(dec, ast.Call):
+        if _is_transform(dec.func):
+            return dec.func
+        chain = _attr_chain(dec.func)
+        if chain and chain[-1] == "partial" and dec.args and _is_transform(dec.args[0]):
+            return dec.args[0]
+    return None
+
+
+def _jit_decorator_donates(dec: ast.expr) -> bool | None:
+    """For a jit decorator, whether it passes donate_argnums/donate_argnames.
+
+    Returns None when the decorator is not a jit at all.
+    """
+    target = _transform_of_decorator(dec)
+    if target is None or _attr_chain(target)[-1] != "jit":
+        return None
+    if isinstance(dec, ast.Call):
+        return any(
+            kw.arg in {"donate_argnums", "donate_argnames"} for kw in dec.keywords
+        )
+    return False
+
+
+@dataclasses.dataclass
+class _FnInfo:
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    traced: bool = False
+    # donated parameter positions when the function is jitted with donation
+    donated: tuple[int, ...] = ()
+
+
+class _ModuleIndex:
+    """Per-module function table + traced-reachability fixpoint."""
+
+    def __init__(self, tree: ast.AST):
+        self.functions: dict[str, _FnInfo] = {}
+        # name -> donated positions, for callables bound via assignment
+        # (``step = jax.jit(fn, donate_argnums=(0,))``).
+        self.donated_callables: dict[str, tuple[int, ...]] = {}
+        self._collect(tree)
+        self._seed(tree)
+        self._propagate()
+
+    # -- collection ---------------------------------------------------------
+    def _collect(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Later defs with the same name shadow earlier ones; for a
+                # lint heuristic, keeping the first is good enough.
+                self.functions.setdefault(node.name, _FnInfo(node=node))
+
+    @staticmethod
+    def _donate_positions(call: ast.Call) -> tuple[int, ...]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    out = []
+                    for e in v.elts:
+                        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                            out.append(e.value)
+                    return tuple(out)
+        return ()
+
+    def _seed(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _transform_of_decorator(dec) is None:
+                        continue
+                    info = self.functions[node.name]
+                    info.traced = True
+                    if isinstance(dec, ast.Call):
+                        chain = _attr_chain(dec.func)
+                        if chain and chain[-1] == "partial":
+                            info.donated = self._donate_positions(dec)
+                        elif _is_transform(dec.func):
+                            info.donated = self._donate_positions(dec)
+            elif isinstance(node, ast.Call) and _is_transform(node.func):
+                # Functions passed positionally to a transform are traced:
+                # jax.jit(fn), jax.lax.scan(body, init, xs), shard_map(f, ...)
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in self.functions:
+                        self.functions[arg.id].traced = True
+                if _attr_chain(node.func)[-1] == "jit":
+                    pos = self._donate_positions(node)
+                    if pos and node.args and isinstance(node.args[0], ast.Name):
+                        fname = node.args[0].id
+                        if fname in self.functions:
+                            self.functions[fname].donated = pos
+
+        # ``step = jax.jit(fn, donate_argnums=(0,))`` binds a donated
+        # callable under a new name used at call sites.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                call = node.value
+                if _is_transform(call.func) and _attr_chain(call.func)[-1] == "jit":
+                    pos = self._donate_positions(call)
+                    if pos:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self.donated_callables[t.id] = pos
+        # Decorated-with-donation functions are donated callables under
+        # their own name.
+        for name, info in self.functions.items():
+            if info.donated:
+                self.donated_callables.setdefault(name, info.donated)
+
+    def _propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for info in self.functions.values():
+                if not info.traced:
+                    continue
+                for node in ast.walk(info.node):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in self.functions
+                    ):
+                        callee = self.functions[node.func.id]
+                        if not callee.traced and callee.node is not info.node:
+                            callee.traced = True
+                            changed = True
+
+    def traced_functions(self) -> list[_FnInfo]:
+        return [i for i in self.functions.values() if i.traced]
+
+
+# ---------------------------------------------------------------------------
+# FTP001 — host sync inside traced code
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "FTP001",
+    "host-sync-in-hot-path",
+    "float()/.item()/np.asarray()/jax.device_get() on device values inside "
+    "a function reachable from a jit/shard_map body — forces a device->host "
+    "sync (or a trace-time concretization error).",
+)
+def check_host_sync(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
+    index = _ModuleIndex(tree)
+    for info in index.traced_functions():
+        fn = info.node
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        tainted = _tainted_locals(fn, params)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # Casts and numpy conversions are only a sync when fed a value
+            # derived from the traced inputs — int(cfg_constant) at trace
+            # time is fine.
+            arg_traced = bool(node.args) and bool(
+                _dynamic_names(node.args[0]) & tainted
+            )
+            msg = None
+            if isinstance(node.func, ast.Name):
+                if node.func.id in _HOST_SYNC_CASTS and arg_traced:
+                    msg = (
+                        f"{node.func.id}() concretizes a traced value; "
+                        "keep it on device (jnp ops) or move to the host path"
+                    )
+            elif isinstance(node.func, ast.Attribute):
+                chain = _attr_chain(node.func)
+                if node.func.attr == "item" and not node.args:
+                    msg = ".item() forces a device->host sync inside traced code"
+                elif (
+                    len(chain) >= 2
+                    and chain[0] in {"np", "numpy", "onp"}
+                    and chain[-1] in {"asarray", "array"}
+                    and arg_traced
+                ):
+                    msg = (
+                        f"{'.'.join(chain)}() pulls the value to host; "
+                        "use jnp inside traced code"
+                    )
+                elif chain[:1] == ["jax"] and chain[-1] == "device_get":
+                    msg = "jax.device_get() inside traced code is a host sync"
+            if msg:
+                yield Finding(
+                    rule="FTP001",
+                    path=path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"[in traced fn `{info.node.name}`] {msg}",
+                )
+
+
+# ---------------------------------------------------------------------------
+# FTP002 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+
+def _is_key_producing_call(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = _attr_chain(node.func)
+    return bool(chain) and chain[-1] in _KEY_PRODUCERS
+
+
+def _is_sampling_call(node: ast.Call) -> bool:
+    chain = _attr_chain(node.func)
+    if len(chain) >= 2 and chain[-2] == "random" and chain[-1] not in _KEY_PRODUCERS:
+        return True
+    return False
+
+
+class _KeyReuseVisitor(ast.NodeVisitor):
+    """Linear walk of one function body tracking PRNG key variables.
+
+    A key var sampled twice without an intervening reassignment — or
+    sampled inside a loop it was created outside of — is reuse.
+    """
+
+    def __init__(self, fn_name: str, path: str):
+        self.fn_name = fn_name
+        self.path = path
+        self.loop_depth = 0
+        self.keys: dict[str, int] = {}  # name -> loop depth at assignment
+        self.used: set[str] = set()
+        self.findings: list[Finding] = []
+
+    # Don't descend into nested function definitions; they get their own walk.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _bind_targets(self, target: ast.expr, is_key: bool) -> None:
+        if isinstance(target, ast.Name):
+            if is_key:
+                self.keys[target.id] = self.loop_depth
+                self.used.discard(target.id)
+            else:
+                self.keys.pop(target.id, None)
+                self.used.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_targets(elt, is_key)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        is_key = _is_key_producing_call(node.value)
+        for t in node.targets:
+            self._bind_targets(t, is_key)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        self._bind_targets(node.target, False)
+        self.loop_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_While(self, node: ast.While) -> None:
+        self.visit(node.test)
+        self.loop_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        if not _is_sampling_call(node):
+            return
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            return
+        name = node.args[0].id
+        if name not in self.keys:
+            return
+        if name in self.used:
+            self.findings.append(
+                Finding(
+                    rule="FTP002",
+                    path=self.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"PRNG key `{name}` already consumed by an earlier "
+                    "jax.random call in `"
+                    f"{self.fn_name}`; split/fold_in before reusing",
+                )
+            )
+        elif self.loop_depth > self.keys[name]:
+            self.findings.append(
+                Finding(
+                    rule="FTP002",
+                    path=self.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"PRNG key `{name}` sampled inside a loop but "
+                    "created outside it; fold_in the loop index first",
+                )
+            )
+        else:
+            self.used.add(name)
+
+
+@rule(
+    "FTP002",
+    "prng-key-reuse",
+    "The same PRNG key fed to two or more jax.random sampling calls "
+    "without an intervening split/fold_in — correlated randomness.",
+)
+def check_key_reuse(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            v = _KeyReuseVisitor(node.name, path)
+            for stmt in node.body:
+                v.visit(stmt)
+            yield from v.findings
+    # Module level too (scripts, tests).
+    if isinstance(tree, ast.Module):
+        v = _KeyReuseVisitor("<module>", path)
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            v.visit(stmt)
+        yield from v.findings
+
+
+# ---------------------------------------------------------------------------
+# FTP003 — donation hazards
+# ---------------------------------------------------------------------------
+
+
+def _flat_assign_names(stmt: ast.stmt) -> set[str]:
+    out: set[str] = set()
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)) and stmt.target is not None:
+        targets = [stmt.target]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+def _statements_in_order(body: list[ast.stmt]) -> list[ast.stmt]:
+    out: list[ast.stmt] = []
+    for stmt in body:
+        out.append(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                out.extend(_statements_in_order(sub))
+        for handler in getattr(stmt, "handlers", []):
+            out.extend(_statements_in_order(handler.body))
+    return out
+
+
+@rule(
+    "FTP003",
+    "donation-hazard",
+    "A donated buffer referenced after the donating call (use-after-donate), "
+    "or a state-threading jitted step missing donate_argnums (copy per round).",
+)
+def check_donation(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
+    index = _ModuleIndex(tree)
+
+    # (a) use-after-donate: a bare-Name argument at a donated position is
+    # loaded again after the call without being rebound first.
+    for fn_info in index.functions.values():
+        fn = fn_info.node
+        stmts = _statements_in_order(fn.body)
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for call in ast.walk(stmt):
+                if not (
+                    isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Name)
+                    and call.func.id in index.donated_callables
+                ):
+                    continue
+                donated_pos = index.donated_callables[call.func.id]
+                rebind_here = _flat_assign_names(stmt)
+                for pos in donated_pos:
+                    if pos >= len(call.args):
+                        continue
+                    arg = call.args[pos]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    name = arg.id
+                    if name in rebind_here:
+                        continue  # `state, m = step(state, ...)` pattern
+                    for later in stmts[i + 1 :]:
+                        if isinstance(later, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            continue
+                        rebinds = name in _flat_assign_names(later)
+                        loads = any(
+                            isinstance(n, ast.Name)
+                            and n.id == name
+                            and isinstance(n.ctx, ast.Load)
+                            for n in ast.walk(later)
+                        )
+                        if loads:
+                            yield Finding(
+                                rule="FTP003",
+                                path=path,
+                                line=later.lineno,
+                                col=later.col_offset,
+                                message=f"`{name}` was donated to "
+                                f"`{call.func.id}()` on line {call.lineno} and "
+                                "its buffer may be invalid here; rebind the "
+                                "result or drop donation",
+                            )
+                            break
+                        if rebinds:
+                            break
+
+    # (b) state-threading jitted step without donation: the round-step
+    # idiom in this repo threads a `state` dict through a jitted function;
+    # without donate_argnums every round copies the full state.
+    for name, info in index.functions.items():
+        fn = info.node
+        for dec in fn.decorator_list:
+            donates = _jit_decorator_donates(dec)
+            if donates is None or donates:
+                continue
+            params = [a.arg for a in fn.args.args]
+            if params and params[0] in {"state", "carry"}:
+                returns_first = any(
+                    isinstance(r, ast.Return)
+                    and r.value is not None
+                    and any(
+                        isinstance(n, ast.Name) and n.id == params[0]
+                        for n in ast.walk(r.value)
+                    )
+                    for r in ast.walk(fn)
+                    if isinstance(r, ast.Return)
+                )
+                if returns_first:
+                    yield Finding(
+                        rule="FTP003",
+                        path=path,
+                        line=fn.lineno,
+                        col=fn.col_offset,
+                        message=f"jitted step `{name}` threads `{params[0]}` "
+                        "through without donate_argnums; each call copies the "
+                        "full state (add donate_argnums=(0,))",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# FTP004 — Python branching on tracer values
+# ---------------------------------------------------------------------------
+
+
+# Array attributes that yield static (python-level) values under tracing.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval", "weak_type"}
+
+# Containers have static truthiness/len even when their elements are tracers,
+# so a name bound to a literal/comprehension is not itself a tracer.
+_CONTAINER_VALUES = (
+    ast.List,
+    ast.Tuple,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.SetComp,
+    ast.DictComp,
+    ast.GeneratorExp,
+)
+
+
+def _dynamic_names(expr: ast.expr) -> set[str]:
+    """Names an expression's *dynamic* value depends on.
+
+    ``x.shape[0]`` depends on x only through static metadata, so x is not
+    included; ``x.sum(axis=1)`` is.
+    """
+    out: set[str] = set()
+
+    def walk(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(expr)
+    return out
+
+
+def _static_params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    """Params whose annotation marks them as static python values."""
+    out: set[str] = set()
+    for a in fn.args.args + fn.args.kwonlyargs:
+        ann = a.annotation
+        if isinstance(ann, ast.Name) and ann.id in {"int", "bool", "str"}:
+            out.add(a.arg)
+    return out
+
+
+def _tainted_locals(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef, params: set[str]
+) -> set[str]:
+    """Params plus locals assigned from expressions that mention a tainted name."""
+    tainted = set(params) - _static_params(fn)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if isinstance(node.value, _CONTAINER_VALUES):
+                    continue
+                if _dynamic_names(node.value) & tainted:
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name) and n.id not in tainted:
+                                tainted.add(n.id)
+                                changed = True
+    return tainted
+
+
+_STATIC_COMPARE_OPS = (ast.In, ast.NotIn, ast.Is, ast.IsNot)
+
+
+def _tracer_names_in_test(test: ast.expr, tainted: set[str]) -> list[ast.Name]:
+    """Bare tainted Names (or tainted subscripts) used as dynamic truth values.
+
+    Skips names reached only through Attribute access (``x.ndim`` is
+    static), call arguments (``len(x)`` is static shape info), and
+    comparisons whose every op is identity/containment.
+    """
+    hits: list[ast.Name] = []
+
+    def walk(node: ast.expr) -> None:
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                walk(v)
+        elif isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            walk(node.operand)
+        elif isinstance(node, ast.Compare):
+            if all(isinstance(op, _STATIC_COMPARE_OPS) for op in node.ops):
+                return
+            walk(node.left)
+            for c in node.comparators:
+                walk(c)
+        elif isinstance(node, ast.Name):
+            if node.id in tainted:
+                hits.append(node)
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.value, ast.Name) and node.value.id in tainted:
+                hits.append(node.value)
+        # Attribute / Call / Constant / everything else: treated as static.
+
+    walk(test)
+    return hits
+
+
+@rule(
+    "FTP004",
+    "tracer-branch",
+    "Python `if`/`while` on a traced value inside a jitted/shard_mapped "
+    "function — trace-time error or silently baked-in control flow; use "
+    "lax.cond / jnp.where.",
+)
+def check_tracer_branch(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
+    index = _ModuleIndex(tree)
+    for info in index.traced_functions():
+        fn = info.node
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        tainted = _tainted_locals(fn, params)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not fn:
+                    continue
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                continue
+            for hit in _tracer_names_in_test(node.test, tainted):
+                yield Finding(
+                    rule="FTP004",
+                    path=path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"[in traced fn `{fn.name}`] Python branch on "
+                    f"`{hit.id}` which may be a tracer; use lax.cond/"
+                    "jnp.where or hoist to a static argument",
+                )

@@ -11,6 +11,11 @@ Subcommands:
     sweep  — the 90-config hyperparameter grid (hyperparameters_tuning.py)
     parity — the sklearn MLPClassifier warm-start limitation demo (FL_SkLearn...)
     presets — list shipped presets
+    report — aggregate a telemetry events JSONL offline (docs/observability.md)
+    lint   — JAX-aware static analysis (FTP rules, docs/analysis.md); pure
+             AST, never touches a backend
+    check  — runtime guard: prove the round step is retrace-free under
+             jax.transfer_guard / the recompile sentinel
 """
 
 from __future__ import annotations
@@ -445,6 +450,50 @@ def build_parser() -> argparse.ArgumentParser:
                           help="also write a Prometheus text-exposition "
                                "snapshot of the aggregated log here")
 
+    # Static analysis: pure AST, no backend, no preset — safe in any
+    # environment (CI lint gates, pre-commit).
+    lint_p = sub.add_parser("lint",
+                            help="JAX-aware static analysis (FTP rules; "
+                                 "see docs/analysis.md)")
+    lint_p.add_argument("paths", nargs="*", default=["fedtpu"],
+                        help="files or directories to lint "
+                             "(default: fedtpu)")
+    lint_p.add_argument("--format", choices=["text", "json"], default="text",
+                        help="finding rendering (default text)")
+    lint_p.add_argument("--select", default=None, metavar="CODES",
+                        help="comma-separated rule codes to run exclusively "
+                             "(e.g. FTP005 or FTP001,FTP002)")
+    lint_p.add_argument("--ignore", default=None, metavar="CODES",
+                        help="comma-separated rule codes to skip")
+    lint_p.add_argument("--show-suppressed", action="store_true",
+                        help="also list findings silenced by "
+                             "'# fedtpu: noqa[CODE]' comments")
+
+    # Runtime guard: drives the real round step under the recompile
+    # sentinel + transfer guard (the dynamic half of the lint rules).
+    check_p = sub.add_parser("check",
+                             help="prove the round step is retrace-free "
+                                  "(recompile sentinel + transfer guard)")
+    check_p.add_argument("--preset", default="income-8",
+                         choices=sorted(PRESETS))
+    check_p.add_argument("--rounds", type=_positive_int, default=4,
+                         help="steady-state steps to drive while armed "
+                              "(default 4)")
+    check_p.add_argument("--transfer-guard",
+                         choices=["allow", "log", "disallow"], default="log",
+                         help="jax.transfer_guard level during the armed "
+                              "window (default log)")
+    check_p.add_argument("--debug-nans", action="store_true",
+                         help="also enable jax_debug_nans for the window")
+    check_p.add_argument("--synthetic-rows", type=_positive_int, default=512,
+                         help="synthetic dataset size (the check probes "
+                              "compilation, not accuracy)")
+    check_p.add_argument("--platform", choices=["default", "cpu"],
+                         default="default",
+                         help="force the JAX platform before backend init")
+    check_p.add_argument("--json", action="store_true",
+                         help="print the check report as one JSON line")
+
     sub.add_parser("presets", help="list shipped presets")
     return parser
 
@@ -458,6 +507,26 @@ def main(argv=None) -> int:
                   f"model={preset.model.kind}{list(preset.model.hidden_sizes)} "
                   f"rounds={preset.fed.rounds} weighting={preset.fed.weighting}")
         return 0
+
+    if args.cmd == "lint":
+        # Before any backend/preset touch: the linter is pure AST and must
+        # work in environments with no jax installed at all.
+        from fedtpu.analysis.engine import lint_paths
+        from fedtpu.analysis.reporters import render_json, render_text
+        select = ([c.strip() for c in args.select.split(",") if c.strip()]
+                  if args.select else None)
+        ignore = ([c.strip() for c in args.ignore.split(",") if c.strip()]
+                  if args.ignore else None)
+        try:
+            result = lint_paths(args.paths, select=select, ignore=ignore)
+        except ValueError as exc:      # unknown rule code
+            raise SystemExit(f"fedtpu lint: {exc}")
+        if args.format == "json":
+            print(render_json(result))
+        else:
+            print(render_text(result,
+                              show_suppressed=args.show_suppressed))
+        return 0 if result.clean else 1
 
     if args.cmd == "report":
         # Before _apply_overrides: the report parser carries no --preset
@@ -490,6 +559,23 @@ def main(argv=None) -> int:
         if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in _os.environ:
             jax.config.update("jax_persistent_cache_min_compile_time_secs",
                               0.5)
+
+    if args.cmd == "check":
+        # Before _apply_overrides: check carries only its own small flag
+        # set (it probes compilation behavior, not experiment config).
+        from fedtpu.analysis.check import run_check
+        report = run_check(preset=args.preset, rounds=args.rounds,
+                           transfer=args.transfer_guard,
+                           nans=args.debug_nans,
+                           synthetic_rows=args.synthetic_rows)
+        if args.json:
+            print(json.dumps(report))
+        else:
+            for key in ("preset", "backend", "device_count", "rounds",
+                        "transfer_guard", "debug_nans",
+                        "sentinel_available", "recompiles", "ok"):
+                print(f"{key}: {report[key]}")
+        return 0 if report["ok"] else 1
 
     cfg = _apply_overrides(get_preset(args.preset), args)
 

@@ -1136,7 +1136,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # error and skip stop_trace/close below.
             try:
                 force_fetch(state["params"])
-            except Exception:
+            except Exception:  # fedtpu: noqa[FTP102] raising here would mask the original error and skip stop_trace/close
                 pass
             jax.profiler.stop_trace()
         if jsonl is not None:

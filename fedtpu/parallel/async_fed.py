@@ -92,9 +92,9 @@ def init_async_state(key: jax.Array, mesh, num_clients: int,
     put = lambda t: jax.device_put(t, shard)
     anchors = jax.tree.map(put, anchors)
     extra = {}
+    from fedtpu.parallel.mesh import replicated_sharding
+    rep = replicated_sharding(mesh)
     if buffer_size >= 2:
-        from fedtpu.parallel.mesh import replicated_sharding
-        rep = replicated_sharding(mesh)
         extra = {
             "buf_delta": jax.tree.map(
                 lambda gl: jax.device_put(
@@ -113,7 +113,9 @@ def init_async_state(key: jax.Array, mesh, num_clients: int,
         "anchors": anchors,                         # pulled global per client
         "opt_state": jax.tree.map(put, jax.vmap(tx.init)(anchors)),
         "pull_tick": put(jnp.zeros((num_clients,), jnp.int32)),
-        "round": jnp.zeros((), jnp.int32),         # server tick counter
+        # Replicated from birth, matching the tick's output sharding — a
+        # SingleDeviceSharding init retraces the second tick (fedtpu check).
+        "round": jax.device_put(jnp.zeros((), jnp.int32), rep),
     }
 
 

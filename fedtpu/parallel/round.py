@@ -147,10 +147,16 @@ def init_federated_state(key: jax.Array, mesh, num_clients: int,
     opt_state = jax.vmap(tx.init)(params)
     shard = client_sharding(mesh)
     put = lambda t: jax.device_put(t, shard)
+    from jax.sharding import NamedSharding
     state = {
         "params": jax.tree.map(put, params),
         "opt_state": jax.tree.map(put, opt_state),
-        "round": jnp.zeros((), jnp.int32),
+        # Replicated placement from birth: the round step returns this
+        # scalar with a replicated NamedSharding, so a SingleDeviceSharding
+        # init would make the second call at each chunk width retrace
+        # (caught by `fedtpu check`'s recompile sentinel).
+        "round": jax.device_put(jnp.zeros((), jnp.int32),
+                                NamedSharding(mesh, P())),
     }
     if server_opt is not None or shared_start:
         g0 = jax.tree.map(lambda p: p.mean(axis=0), params)

@@ -199,8 +199,12 @@ def init_federated_state_2d(key: jax.Array, mesh: Mesh, num_clients: int,
         return params, jax.vmap(tx.init)(params)
 
     params, opt_state = _sharded_init(keys)
+    # Replicated from birth — the step returns the counter with a
+    # replicated NamedSharding, and a SingleDeviceSharding init would
+    # retrace the second call (caught by `fedtpu check`).
     state = {"params": params, "opt_state": opt_state,
-             "round": jnp.zeros((), jnp.int32)}
+             "round": jax.device_put(jnp.zeros((), jnp.int32),
+                                     NamedSharding(mesh, P()))}
     if server_opt is not None:
         g0 = jax.tree.map(lambda p: p[0], params)
         # f32 server accumulators regardless of param dtype, matching the

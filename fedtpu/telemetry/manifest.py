@@ -69,13 +69,13 @@ def build_manifest(cfg=None, mesh=None, extra: Optional[dict] = None) -> dict:
         out["device_kinds"] = sorted({d.device_kind for d in devs})
         out["process_index"] = jax.process_index()
         out["process_count"] = jax.process_count()
-    except Exception:
+    except Exception:  # fedtpu: noqa[FTP102] manifest is best-effort; no backend must not kill the run
         pass
     if mesh is not None:
         try:
             out["mesh_shape"] = {axis: int(n) for axis, n
                                  in mesh.shape.items()}
-        except Exception:
+        except Exception:  # fedtpu: noqa[FTP102] mesh introspection differs across jax versions; manifest stays best-effort
             pass
     if extra:
         out.update(extra)

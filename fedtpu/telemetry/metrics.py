@@ -152,7 +152,7 @@ def install_compile_probe() -> bool:
                 reg = default_registry()
                 reg.counter("jax_compile_events").inc()
                 reg.counter("jax_compile_secs").inc(float(duration))
-        except Exception:
+        except Exception:  # fedtpu: noqa[FTP102] never raise into jax's monitoring dispatch
             pass
 
     try:
@@ -180,5 +180,5 @@ def device_memory_gauges(registry: Optional[MetricsRegistry] = None) -> None:
         stats = jax.local_devices()[0].memory_stats()
         if stats and "bytes_in_use" in stats:
             reg.gauge("device_bytes_in_use").set(stats["bytes_in_use"])
-    except Exception:
+    except Exception:  # fedtpu: noqa[FTP102] telemetry must not kill the run it observes; buffers may be deleted mid-failure
         pass

@@ -153,6 +153,12 @@ QUICK_TESTS = {
     "test_adaptive_clip.py::test_one_round_clip_update_matches_oracle",
     "test_async.py::test_guards",
     "test_async.py::test_staleness_bookkeeping_under_sampling",
+    # round-5 modules
+    # static-analysis subsystem (rule engine is pure AST — both picks are
+    # backend-free and fast)
+    "test_analysis.py::test_rule_fixtures_catch_seeded_violations",
+    "test_analysis.py::test_text_reporter_golden",
+    "test_lint_gate.py::test_repo_lint_gate_is_clean",
     # test_multihost_e2e spawns 2 OS processes (~70 s for the round-kernel
     # worker since the int8/Byzantine sections joined) and stays full-tier
     # only; fedtpu/parallel/multihost.py is covered above in-process.
@@ -166,6 +172,10 @@ def pytest_configure(config):
         "markers",
         "quick: CI-fast tier (<2 min) touching every test module; "
         "run with `pytest -m quick`")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` flow (ROADMAP.md); "
+        "full-tier only")
 
 
 def pytest_collection_modifyitems(config, items):

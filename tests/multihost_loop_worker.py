@@ -109,7 +109,7 @@ def main():
         }
         with open(os.path.join(outdir, f"loop_{pid}.json"), "w") as f:
             json.dump(out, f)
-        print(f"sweep worker {pid}: ok best={out['best_params']}",
+        print(f"sweep worker {pid}: ok best={out['best_params']}",  # fedtpu: noqa[FTP005] stdout IS the worker->parent IPC protocol
               flush=True)
         return
 
@@ -145,7 +145,7 @@ def main():
 
     with open(os.path.join(outdir, f"loop_{pid}.json"), "w") as f:
         json.dump(out, f)
-    print(f"loop worker {pid}: ok rounds={res.rounds_run}", flush=True)
+    print(f"loop worker {pid}: ok rounds={res.rounds_run}", flush=True)  # fedtpu: noqa[FTP005] stdout IS the worker->parent IPC protocol
 
 
 if __name__ == "__main__":

@@ -87,7 +87,7 @@ def main():
     np.save(os.path.join(outdir, f"params_{pid}.npy"), local0)
     with open(os.path.join(outdir, f"acc_{pid}.txt"), "w") as f:
         f.write(repr(acc))
-    print(f"worker {pid}: ok acc={acc:.4f}", flush=True)
+    print(f"worker {pid}: ok acc={acc:.4f}", flush=True)  # fedtpu: noqa[FTP005] stdout IS the worker->parent IPC protocol
 
     # --- Explicit ring (ppermute) aggregation ACROSS the process boundary.
     # psum lets XLA choose the collective; the ring path spells out the
@@ -120,7 +120,7 @@ def main():
     psum_g = fetch_global(psum_state["params"], mesh)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
                  ring_g, psum_g)
-    print(f"worker {pid}: ring == psum across processes ok", flush=True)
+    print(f"worker {pid}: ring == psum across processes ok", flush=True)  # fedtpu: noqa[FTP005] stdout IS the worker->parent IPC protocol
 
     # --- True tp-over-DCN: a ('clients','model') mesh whose MODEL-axis
     # pairs each span BOTH processes (devices [[0,4],[1,5],[2,6],[3,7]]),
@@ -150,7 +150,7 @@ def main():
     acc2 = float(np.asarray(m2["client_mean"]["accuracy"]))
     with open(os.path.join(outdir, f"tp_acc_{pid}.txt"), "w") as f:
         f.write(repr(acc2))
-    print(f"worker {pid}: tp-over-DCN round ok acc={acc2:.4f}", flush=True)
+    print(f"worker {pid}: tp-over-DCN round ok acc={acc2:.4f}", flush=True)  # fedtpu: noqa[FTP005] stdout IS the worker->parent IPC protocol
 
     # --- int8-quantized exchange across the process boundary: the
     # all_gather of int8 payloads + per-client scales crosses TCP (the
@@ -165,7 +165,7 @@ def main():
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=5e-4),
                  q_g, psum_g)
     assert np.isfinite(float(np.asarray(qm["client_mean"]["accuracy"])))
-    print(f"worker {pid}: int8 exchange across processes ok", flush=True)
+    print(f"worker {pid}: int8 exchange across processes ok", flush=True)  # fedtpu: noqa[FTP005] stdout IS the worker->parent IPC protocol
 
     # --- Byzantine-robust median with the attack crossing the boundary:
     # clients 0-1 (process 0's devices) submit 10x sign-flipped updates;
@@ -188,7 +188,7 @@ def main():
                               robust_aggregation="median")
     assert attacked_mean > 1.5 * honest, (honest, attacked_mean)
     assert defended <= 1.5 * honest, (honest, defended)
-    print(f"worker {pid}: median holds under cross-process Byzantine "
+    print(f"worker {pid}: median holds under cross-process Byzantine "  # fedtpu: noqa[FTP005] stdout IS the worker->parent IPC protocol
           f"injection ok (honest {honest:.2e}, mean {attacked_mean:.2e}, "
           f"median {defended:.2e})", flush=True)
 

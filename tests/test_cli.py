@@ -174,7 +174,7 @@ def test_every_documented_flag_exists_in_the_parser():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     documented = set()
     for rel in ("README.md", "docs/API.md", "docs/ARCHITECTURE.md",
-                "docs/observability.md", "PARITY.md",
+                "docs/observability.md", "docs/analysis.md", "PARITY.md",
                 "benchmarks/RESULTS.md"):
         text = open(os.path.join(root, rel)).read()
         # Underscores ARE captured so `--dp_clip_norm`-style typos show up

@@ -331,28 +331,15 @@ def test_reference_parity_lines_unchanged_with_telemetry_on(tmp_path,
 def test_no_bare_prints_outside_allowlist():
     """Every user-facing line goes through the telemetry logger (leveled,
     mirrored to the sink) — a new bare print() in fedtpu/ fails here.
-    Allowlist: the logger itself and the CLI's own output layer."""
-    import ast
+
+    The walk + allowlist that used to live inline here is now rule FTP005
+    (fedtpu.analysis.rules_generic.PRINT_ALLOWLIST — one place), so this
+    test is a thin ``fedtpu lint --select FTP005`` invocation."""
+    from fedtpu.cli import main as cli_main
 
     root = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "fedtpu")
-    allow = {os.path.join("fedtpu", "telemetry", "log.py"),
-             os.path.join("fedtpu", "cli.py")}
-    offenders = []
-    for dirpath, _, files in os.walk(root):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, os.path.dirname(root))
-            if rel in allow:
-                continue
-            tree = ast.parse(open(path).read(), filename=rel)
-            for node in ast.walk(tree):
-                if (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Name)
-                        and node.func.id == "print"):
-                    offenders.append(f"{rel}:{node.lineno}")
-    assert not offenders, (
-        "bare print() outside the allowlist (use fedtpu.telemetry's "
-        f"TelemetryLogger instead): {offenders}")
+    assert cli_main(["lint", root, "--select", "FTP005"]) == 0, (
+        "bare print() outside the FTP005 allowlist (use fedtpu.telemetry's "
+        "TelemetryLogger instead); run `fedtpu lint --select FTP005` "
+        "for locations")
