@@ -107,6 +107,8 @@ def bench_fedtpu(ds) -> dict:
 
     sweep = {}
     flops_per_round = None
+    cold_compile_s = None
+    warm_lookup_ms = None
     for rps in RPS_SWEEP:
         state = init_federated_state(jax.random.key(0), mesh, NUM_CLIENTS,
                                      init_fn, tx)
@@ -116,7 +118,15 @@ def bench_fedtpu(ds) -> dict:
         # no floor, no number. A lax.scan body is counted ONCE regardless
         # of length, so the scanned program's "flops" IS the per-round cost
         # (verified: cost(rps=100) == cost(rps=1) on this backend).
+        t_compile = time.perf_counter()
         step, flops_per_round = compile_with_flops(step, state, batch)
+        if rps == HEADLINE_RPS:
+            # Compile-cost companion numbers for the headline program: what
+            # a cold start pays (trace+XLA compile) vs what a warm
+            # --compilation-cache start pays instead (serialized-executable
+            # round-trip through fedtpu.compilation.ProgramCache).
+            cold_compile_s = time.perf_counter() - t_compile
+            warm_lookup_ms = _warm_lookup_ms(step)
 
         # PIPELINED throughput: back-to-back calls, one completion-proving
         # fetch at the end (the fixed-rounds production shape — run N
@@ -191,7 +201,24 @@ def bench_fedtpu(ds) -> dict:
             "flops_per_round": flops_per_round,
             "mfu": head["mfu"],
             "recompiles": sentinel.count,
+            "cold_compile_s": cold_compile_s,
+            "warm_lookup_ms": warm_lookup_ms,
             "sweep": sweep}
+
+
+def _warm_lookup_ms(compiled):
+    """Serialized-executable round-trip for the headline program: store to
+    a scratch ProgramCache, then time a FRESH cache instance's load — the
+    startup cost a warm ``--compilation-cache`` run pays in place of
+    cold_compile_s (benchmarks/compile_bench.py asserts the ratio)."""
+    import tempfile
+
+    from fedtpu.compilation import ProgramCache
+    with tempfile.TemporaryDirectory() as d:
+        if not ProgramCache(d).store("bench-headline", compiled):
+            return None                 # serialization unsupported here
+        entry = ProgramCache(d).load("bench-headline")
+        return entry.seconds * 1e3 if entry is not None else None
 
 
 def bench_mfu_capability(peak: float) -> dict:
@@ -377,7 +404,15 @@ def main(argv=None):
     ap.add_argument("--events", default=None, metavar="PATH",
                     help="telemetry JSONL sink for per-stage bench spans "
                          "(inspect with 'fedtpu report PATH')")
+    ap.add_argument("--compilation-cache", default=None, metavar="DIR",
+                    help="persistent XLA compilation cache dir; a warm "
+                         "cache collapses cold_compile_s to the "
+                         "deserialize cost (docs/performance.md)")
     args = ap.parse_args(argv)
+
+    if args.compilation_cache:
+        from fedtpu.compilation import configure_persistent_cache
+        configure_persistent_cache(args.compilation_cache)
 
     from fedtpu.telemetry import build_manifest, make_tracer
     tracer = make_tracer(args.events)
@@ -413,6 +448,13 @@ def main(argv=None):
         # sentinel, fedtpu.analysis.guards): must be 0 — a nonzero count
         # means the quoted numbers include silent retrace cost.
         "recompiles": ours["recompiles"],
+        # Startup-cost pair for the headline program: trace+compile from
+        # nothing vs a warm ProgramCache deserialize (what a
+        # --compilation-cache / 'fedtpu warmup' start pays instead).
+        "cold_compile_s": g3(ours["cold_compile_s"])
+        if ours["cold_compile_s"] is not None else None,
+        "warm_lookup_ms": g3(ours["warm_lookup_ms"])
+        if ours["warm_lookup_ms"] is not None else None,
         # The headline mfu above is the income workload's BANDWIDTH roofline
         # (~22% marginal, byte-bound — RESULTS.md); this row is the same
         # engine at an MXU-sized shape, dispatch-cancelled slope timing.
@@ -453,6 +495,11 @@ def main(argv=None):
         f"{ours['flops_per_round']:.2e} FLOPs/round, "
         f"MFU {100 * ours['mfu']:.1f}%, "
         f"{ours['recompiles']} in-window recompiles",
+        f"[bench] headline compile cost: cold {ours['cold_compile_s']:.3f} s"
+        f", warm deserialize {ours['warm_lookup_ms']:.1f} ms"
+        if ours["cold_compile_s"] is not None
+        and ours["warm_lookup_ms"] is not None else
+        "[bench] headline compile cost: unavailable",
         f"[bench] MFU capability (hidden {capability['hidden']}, "
         f"{capability['rows_per_client']} rows/client, slope-timed): "
         f"{capability['marginal_s_per_round']:.3e} s/round, "

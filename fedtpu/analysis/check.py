@@ -29,6 +29,7 @@ def run_check(
     transfer: str = "log",
     nans: bool = False,
     synthetic_rows: int = 512,
+    warmup_cache: Optional[str] = None,
     registry=None,
 ) -> dict:
     """Run the retrace/transfer check; returns a JSON-serializable report.
@@ -41,6 +42,14 @@ def run_check(
 
     from fedtpu.config import get_preset
     from fedtpu.orchestration.loop import build_experiment
+
+    if warmup_cache:
+        # Apply the persistent cache before any compile so the retrace
+        # gate also validates warm-cache startup (the sentinel semantics
+        # are unchanged: cache hits are deserializations, not backend
+        # compiles, so a warm start must still report recompiles == 0).
+        from fedtpu.compilation import configure_persistent_cache
+        warmup_cache = configure_persistent_cache(warmup_cache)
 
     cfg = get_preset(preset)
     # Force the small synthetic dataset: the check probes compilation
@@ -78,6 +87,7 @@ def run_check(
         "rounds": rounds,
         "transfer_guard": transfer,
         "debug_nans": nans,
+        "warmup_cache": warmup_cache,
         "sentinel_available": sentinel.available,
         "recompiles": sentinel.count,
         "backend": jax.default_backend(),

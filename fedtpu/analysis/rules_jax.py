@@ -1,4 +1,4 @@
-"""JAX-aware rules: FTP001-FTP004.
+"""JAX-aware rules: FTP001-FTP004, FTP006.
 
 All four rules hang off the same module-level reachability analysis: a
 function is *traced* if it is decorated with (or passed to) a JAX
@@ -673,3 +673,87 @@ def check_tracer_branch(tree: ast.AST, src: str, path: str) -> Iterable[Finding]
                     f"`{hit.id}` which may be a tracer; use lax.cond/"
                     "jnp.where or hoist to a static argument",
                 )
+
+
+# ---------------------------------------------------------------------------
+# FTP006 — jit wrapper rebuilt per iteration / per call
+# ---------------------------------------------------------------------------
+
+
+def _is_jit_construction(node: ast.expr) -> bool:
+    """``jax.jit(fn, ...)`` / ``jit(fn, ...)`` — a call that builds a new
+    jit wrapper around a function (as opposed to ``@jax.jit`` decorator
+    syntax, which the AST represents without a construction Call unless
+    parameterized)."""
+    if not isinstance(node, ast.Call):
+        return False
+    chain = _attr_chain(node.func)
+    if not chain or chain[-1] != "jit":
+        return False
+    if len(chain) > 1 and chain[0] != "jax":
+        return False
+    # A wrapper construction takes the function positionally; bare
+    # ``jax.jit(...)`` decorator-factory calls (keywords only) configure a
+    # decorator and are handled at their FunctionDef site.
+    return bool(node.args)
+
+
+@rule(
+    "FTP006",
+    "jit-rebuilt-per-call",
+    "jax.jit(...) constructed inside a Python loop, or invoked immediately "
+    "(jax.jit(f)(x)): every iteration/call builds a fresh wrapper with an "
+    "empty compilation cache, so XLA recompiles work it already compiled. "
+    "Hoist the jitted callable out (or AOT-compile once via "
+    "fedtpu.compilation.ProgramCache).",
+)
+def check_jit_rebuilt(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
+    # (a) wrapper construction lexically inside a loop: the wrapper (and
+    # its private jit cache) is rebuilt every iteration. ``.lower()``
+    # chained onto such a construction is the same defect — the lowering
+    # is re-traced per iteration.
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for node in ast.walk(loop):
+            if node is loop or not _is_jit_construction(node):
+                continue
+            yield Finding(
+                rule="FTP006",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message="jax.jit wrapper constructed inside a loop is "
+                "rebuilt (cache and all) every iteration; hoist the "
+                "jitted callable out of the loop",
+            )
+    # (b) immediately-invoked construction anywhere: jax.jit(f)(x) and
+    # jax.jit(f).lower(x) throw the wrapper away after one use, so a
+    # per-call function body re-jits on every call.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_jit_construction(node.func):
+            yield Finding(
+                rule="FTP006",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message="jax.jit(f)(...) builds and discards the wrapper "
+                "per call — the compile is never reused; bind the jitted "
+                "callable once and call that",
+            )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "lower"
+            and _is_jit_construction(node.func.value)
+        ):
+            yield Finding(
+                rule="FTP006",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message="jax.jit(f).lower(...) re-traces through a "
+                "throwaway wrapper; bind the jitted callable (or cache "
+                "the Compiled via fedtpu.compilation.ProgramCache)",
+            )

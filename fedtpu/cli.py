@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 
 from fedtpu.config import PRESETS, get_preset, ExperimentConfig
@@ -307,6 +308,14 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["eval_test_every"] = args.eval_test_every
     if args.rounds_per_step is not None:
         run_kw["rounds_per_step"] = args.rounds_per_step
+    if getattr(args, "compilation_cache", None):
+        # Mirrored into RunConfig so run_experiment / the sweep (and any
+        # library caller handed this config) apply the persistent cache
+        # themselves — the process-global config in main() only covers the
+        # CLI path.
+        run_kw["compilation_cache"] = os.path.abspath(args.compilation_cache)
+    if getattr(args, "overlap_compile", False):
+        run_kw["overlap_compile"] = True
     if args.profile_dir is not None:
         run_kw["profile_dir"] = args.profile_dir
     if args.metrics_jsonl is not None:
@@ -358,6 +367,11 @@ def build_parser() -> argparse.ArgumentParser:
                             "chunk's device execution; stop decisions lag "
                             "one chunk (recorded history stays identical "
                             "to the synchronous loop)")
+    run_p.add_argument("--overlap-compile", action="store_true",
+                       help="with --rounds-per-step R>1, train R=1 warmup "
+                            "rounds while the R-wide chunk program compiles "
+                            "on a background thread (bitwise-identical "
+                            "results; composes with --compilation-cache)")
     run_p.add_argument("--resume", action="store_true",
                        help="resume from the latest checkpoint in "
                             "--checkpoint-dir")
@@ -423,6 +437,12 @@ def build_parser() -> argparse.ArgumentParser:
                               "bucketing cuts the 90-config grid from 10 "
                               "compiles to 2 — benchmarks/RESULTS.md "
                               "'Sweep wall clock')")
+    sweep_p.add_argument("--no-overlap-compile", action="store_true",
+                         help="compile each depth bucket's program eagerly "
+                              "at dispatch instead of on a background "
+                              "thread while the previous bucket executes "
+                              "(the overlap is bitwise-identical; this is "
+                              "the parity-check path)")
     sweep_p.add_argument("--plateau-stop", action="store_true",
                          help="sklearn-faithful local fits: treat the step "
                               "budget as a cap and stop each (client, lr) "
@@ -493,6 +513,45 @@ def build_parser() -> argparse.ArgumentParser:
                          help="force the JAX platform before backend init")
     check_p.add_argument("--json", action="store_true",
                          help="print the check report as one JSON line")
+    check_p.add_argument("--warmup-cache", default=None, metavar="DIR",
+                         help="apply this persistent compilation cache "
+                              "before building, so the retrace gate also "
+                              "validates warm-cache startup (pair with "
+                              "'fedtpu warmup --cache DIR')")
+
+    # AOT pre-compilation: populate a persistent cache with a preset's
+    # program family so later runs/sweeps start warm (docs/performance.md).
+    warmup_p = sub.add_parser("warmup",
+                              help="pre-compile a preset's program family "
+                                   "into a persistent cache dir")
+    warmup_p.add_argument("--preset", default="income-8",
+                          choices=sorted(PRESETS))
+    warmup_p.add_argument("--cache", required=True, metavar="DIR",
+                          help="cache directory (created if missing); "
+                              "holds the XLA backend cache plus serialized "
+                              "executables under programs/")
+    warmup_p.add_argument("--widths", default=None, metavar="R[,R...]",
+                          help="comma-separated chunk widths "
+                               "(rounds-per-step values) to pre-compile; "
+                               "default: 1 plus the preset's "
+                               "rounds_per_step")
+    warmup_p.add_argument("--synthetic-rows", type=_positive_int,
+                          default=None,
+                          help="force a synthetic dataset of this many rows "
+                               "(warmup probes compilation, not accuracy; "
+                               "default: the preset's own data)")
+    warmup_p.add_argument("--no-eval", action="store_true",
+                          help="skip pre-compiling the eval program")
+    warmup_p.add_argument("--events", default=None, metavar="JSONL",
+                          help="write compile spans to this telemetry "
+                               "events sink")
+    warmup_p.add_argument("--platform", choices=["default", "cpu"],
+                          default="default",
+                          help="force the JAX platform before backend init")
+    warmup_p.add_argument("--json", action="store_true",
+                          help="print the warmup report as one JSON line")
+    warmup_p.add_argument("--quiet", action="store_true",
+                          help="suppress per-program progress lines")
 
     sub.add_parser("presets", help="list shipped presets")
     return parser
@@ -549,16 +608,36 @@ def main(argv=None) -> int:
     if getattr(args, "compilation_cache", None):
         # Before any compile: every subcommand's first jit lands in (or is
         # served from) the on-disk cache across CLI invocations.
-        import os as _os
+        from fedtpu.compilation import configure_persistent_cache
+        configure_persistent_cache(args.compilation_cache)
 
-        import jax
-        jax.config.update("jax_compilation_cache_dir",
-                          _os.path.abspath(args.compilation_cache))
-        # Lower JAX's 1.0 s threshold so the seconds-scale round programs
-        # all qualify — but never clobber an explicit user setting.
-        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in _os.environ:
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              0.5)
+    if args.cmd == "warmup":
+        # Before _apply_overrides: warmup carries only its own flag set
+        # (the preset's config IS the program being pre-compiled).
+        from fedtpu.compilation import warmup_preset
+        from fedtpu.telemetry import make_tracer
+        widths = ([int(w) for w in args.widths.split(",") if w.strip()]
+                  if args.widths else None)
+        tracer = make_tracer(args.events)
+        try:
+            report = warmup_preset(preset=args.preset, cache_dir=args.cache,
+                                   widths=widths,
+                                   synthetic_rows=args.synthetic_rows,
+                                   include_eval=not args.no_eval,
+                                   tracer=tracer)
+        finally:
+            tracer.close()
+        if args.json:
+            print(json.dumps(report))
+        elif not args.quiet:
+            for prog in report["programs"]:
+                state = "warm" if prog["warm"] else "cold"
+                print(f"{prog['label']}: {state} {prog['seconds']:.3f}s "
+                      f"key={prog['key']}")
+            print(f"cache: {report['dir']} entries={report['entries']} "
+                  f"hits={report['hits']} misses={report['misses']} "
+                  f"total={report['total_s']:.3f}s")
+        return 0
 
     if args.cmd == "check":
         # Before _apply_overrides: check carries only its own small flag
@@ -567,12 +646,13 @@ def main(argv=None) -> int:
         report = run_check(preset=args.preset, rounds=args.rounds,
                            transfer=args.transfer_guard,
                            nans=args.debug_nans,
-                           synthetic_rows=args.synthetic_rows)
+                           synthetic_rows=args.synthetic_rows,
+                           warmup_cache=args.warmup_cache)
         if args.json:
             print(json.dumps(report))
         else:
             for key in ("preset", "backend", "device_count", "rounds",
-                        "transfer_guard", "debug_nans",
+                        "transfer_guard", "debug_nans", "warmup_cache",
                         "sentinel_available", "recompiles", "ok"):
                 print(f"{key}: {report[key]}")
         return 0 if report["ok"] else 1
@@ -612,6 +692,7 @@ def main(argv=None) -> int:
                 plateau_stop=args.plateau_stop,
                 bucket_pad=not args.no_bucket_pad,
                 vmap_arch=not args.no_vmap_arch,
+                overlap_compile=not args.no_overlap_compile,
                 verbose=not args.quiet)
             if table_f is not None:
                 for row in summary["table"]:

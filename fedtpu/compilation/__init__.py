@@ -1,0 +1,35 @@
+"""AOT compilation subsystem: persist, key and overlap XLA compilation.
+
+Three layers (ISSUE 3):
+
+- :mod:`fedtpu.compilation.cache` — ``ProgramCache``, a content-addressed
+  store of serialized executables with integrity/version guards, plus
+  ``configure_persistent_cache`` for jax's backend compilation cache;
+- :mod:`fedtpu.compilation.executor` — ``CompileExecutor``, a background
+  compile thread pool that builds not-yet-needed programs while the
+  current one runs;
+- :mod:`fedtpu.compilation.warmup` — ``warmup_preset``, the ``fedtpu
+  warmup`` driver pre-compiling a preset's program family into a cache
+  directory.
+
+Import-light: jax loads only when a compile/lookup actually happens.
+"""
+
+from fedtpu.compilation.cache import (CACHE_FORMAT_VERSION, CacheEntry,
+                                      ProgramCache, configure_persistent_cache,
+                                      environment_fingerprint,
+                                      program_fingerprint)
+from fedtpu.compilation.executor import CompileExecutor
+from fedtpu.compilation.warmup import program_config_slice, warmup_preset
+
+__all__ = [
+    "CACHE_FORMAT_VERSION",
+    "CacheEntry",
+    "CompileExecutor",
+    "ProgramCache",
+    "configure_persistent_cache",
+    "environment_fingerprint",
+    "program_config_slice",
+    "program_fingerprint",
+    "warmup_preset",
+]

@@ -1,0 +1,111 @@
+"""Background compile thread pool (CompileExecutor).
+
+Hides compilation behind running compute: while sweep bucket k executes,
+bucket k+1's program lowers and compiles on a worker thread; while R=1
+warmup rounds already train, the R-wide chunk program builds in the
+background. Dispatch then blocks only if the executable is not ready
+yet — never to start a compile it could have overlapped.
+
+jax tracing/lowering/compilation is thread-safe (compilation itself
+releases the GIL inside XLA), so a single worker thread is enough to
+overlap compile with the host-side dispatch/fetch of the running
+program without oversubscribing the machine. Builds are deduplicated by
+key: submitting the same key twice returns the same future, mirroring
+the jit cache's per-shape semantics.
+
+Failures are not raised on the worker: ``get`` re-raises the build
+exception at the dispatch site so callers can fall back to the eager
+path (see ``fedtpu/sweep/grid.py``) with the error attributed to the
+launch that needed the program.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["CompileExecutor"]
+
+
+class CompileExecutor:
+    """Keyed, deduplicating thread pool for AOT program builds."""
+
+    def __init__(self, max_workers: int = 1, tracer=None, registry=None):
+        if tracer is None:
+            from fedtpu.telemetry import NullTracer
+            tracer = NullTracer()
+        self.tracer = tracer
+        self.registry = registry
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="fedtpu-compile")
+        self._futures: Dict[str, Future] = {}
+        self._submitted_at: Dict[str, float] = {}
+
+    # ---------------------------------------------------------- lifecycle
+    def __enter__(self) -> "CompileExecutor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def shutdown(self, wait: bool = False) -> None:
+        """Cancel queued builds; by default do not block on in-flight
+        ones (an unused background compile must not delay run exit)."""
+        self._pool.shutdown(wait=wait, cancel_futures=True)
+
+    # ------------------------------------------------------------- submit
+    def submit(self, key: str, build: Callable[[], Any],
+               label: str = "program") -> Future:
+        """Schedule ``build()`` under ``key``; duplicate keys return the
+        already-scheduled future (one compile per distinct program)."""
+        fut = self._futures.get(key)
+        if fut is not None:
+            return fut
+        self._submitted_at[key] = time.perf_counter()
+        # jax.default_device is thread-local: a caller running under a
+        # device pin (e.g. a CPU-pinned dryrun on a box whose default
+        # backend is an accelerator) must not have its build dispatch
+        # trace-time constants to a different backend on the worker.
+        import jax
+        default_device = jax.config.jax_default_device
+
+        def _run():
+            t0 = time.perf_counter()
+            with jax.default_device(default_device):
+                out = build()
+            self.tracer.event("background_compile", phase="built", key=key,
+                              label=label,
+                              compile_s=time.perf_counter() - t0)
+            if self.registry is not None:
+                self.registry.counter("background_compiles").inc()
+            return out
+
+        fut = self._pool.submit(_run)
+        self._futures[key] = fut
+        return fut
+
+    def succeeded(self) -> list:
+        """Keys whose build completed without error (compile accounting)."""
+        return [key for key, fut in self._futures.items()
+                if fut.done() and not fut.cancelled()
+                and fut.exception() is None]
+
+    # --------------------------------------------------------------- get
+    def done(self, key: str) -> bool:
+        fut = self._futures.get(key)
+        return fut is not None and fut.done()
+
+    def get(self, key: str, timeout: Optional[float] = None) -> Any:
+        """Block until ``key``'s build finishes and return it. The time
+        spent blocked (compile not hidden by compute) is traced so the
+        overlap win stays measurable. Re-raises build errors."""
+        fut = self._futures[key]
+        waited0 = time.perf_counter()
+        out = fut.result(timeout=timeout)
+        blocked_s = time.perf_counter() - waited0
+        self.tracer.event("background_compile", phase="acquired", key=key,
+                          blocked_s=blocked_s)
+        if self.registry is not None and blocked_s > 1e-3:
+            self.registry.counter("background_compile_stall_s").inc(blocked_s)
+        return out

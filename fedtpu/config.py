@@ -313,6 +313,15 @@ class RunConfig:
     # (fedtpu.parallel.tp): hidden weights shard over a tensor-parallel axis
     # of this extent. MLP only; partial participation unsupported there.
     model_parallel: int = 1
+    # Persistent XLA compilation-cache directory (None = off). Applied by
+    # run_experiment / the sweep / bench via
+    # fedtpu.compilation.configure_persistent_cache, so library callers get
+    # the same warm-start behavior as the CLI's --compilation-cache flag.
+    compilation_cache: Optional[str] = None
+    # Background-compile the rounds_per_step-wide chunk program while R=1
+    # warmup rounds already train (fedtpu.compilation.CompileExecutor);
+    # bitwise-identical results, shorter time-to-first-round.
+    overlap_compile: bool = False
     # Structured telemetry (span/event sink, manifest, logger level).
     telemetry: TelemetryConfig = TelemetryConfig()
 

@@ -282,7 +282,7 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
         if isinstance(l, jax.Array) and not l.is_fully_addressable:
             if sh is None:
                 return l                      # already a fine global array
-            return jax.jit(identity, out_shardings=sh)(l)
+            return jax.jit(identity, out_shardings=sh)(l)  # fedtpu: noqa[FTP006] one-shot resume-time reshard, not a hot path
         return jax.device_put(l) if sh is None else jax.device_put(l, sh)
 
     if state_like is not None and any(

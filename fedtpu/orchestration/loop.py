@@ -536,17 +536,73 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     log = TelemetryLogger(verbose=verbose, tracer=tracer,
                           level=tel.log_level)
 
+    if cfg.run.compilation_cache:
+        # Before ANY compile (build_experiment may already trace programs):
+        # the same entry point the CLI's --compilation-cache flag uses, so
+        # library callers get identical warm-start behavior.
+        from fedtpu.compilation import configure_persistent_cache
+        configure_persistent_cache(cfg.run.compilation_cache)
+
     with tracer.span("build"):
         exp = build_experiment(cfg, dataset)
     state, batch, eval_step, ds = exp.state, exp.batch, exp.eval_step, exp.dataset
 
+    # Overlap compile (fedtpu.compilation): the rounds_per_step-wide chunk
+    # program builds on a background thread — from abstract avals, through
+    # the serialized-executable ProgramCache when a cache dir is set — while
+    # R=1 warmup rounds already train. Bitwise-identical results (R width-1
+    # chunks compute exactly what one R-wide chunk computes); dispatch
+    # blocks only if the executable isn't ready when it is finally needed.
+    overlap_exec = None
+    overlap_cache = None
+    overlap_key = None
+    overlap_chunk = max(1, cfg.run.rounds_per_step)
+    if (cfg.run.overlap_compile and overlap_chunk > 1
+            and cfg.fed.rounds > 1):
+        from fedtpu.compilation import (CompileExecutor, ProgramCache,
+                                        program_config_slice,
+                                        program_fingerprint)
+        from fedtpu.compilation.warmup import PROGRAMS_SUBDIR
+        _wide_step = exp.make_step(overlap_chunk)
+        _abstract = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=a.sharding),
+            (state, batch))
+        overlap_key = program_fingerprint(
+            "round", config=program_config_slice(cfg), mesh=exp.mesh,
+            args=_abstract, extra={"rounds_per_step": overlap_chunk})
+        if cfg.run.compilation_cache:
+            overlap_cache = ProgramCache(
+                os.path.join(cfg.run.compilation_cache, PROGRAMS_SUBDIR),
+                tracer=tracer, registry=registry)
+        overlap_exec = CompileExecutor(tracer=tracer, registry=registry)
+
+        def _build_wide(step=_wide_step, avals=_abstract):
+            if overlap_cache is not None:
+                return overlap_cache.get_or_compile(
+                    overlap_key, step, *avals,
+                    label=f"round[w={overlap_chunk}]").compiled
+            return step.lower(*avals).compile()
+
+        overlap_exec.submit(overlap_key, _build_wide,
+                            label=f"round[w={overlap_chunk}]")
+
     if tel.manifest:
+        manifest_extra = {"program": "run",
+                          "engine": ("async" if cfg.fed.async_mode
+                                     else "tp2d" if cfg.run.model_parallel > 1
+                                     else "sync1d")}
+        if overlap_key is not None:
+            # Cache directory + hit/miss state for the run's main program
+            # (peek: no deserialization at manifest time).
+            manifest_extra["program_cache"] = {
+                "key": overlap_key,
+                "dir": overlap_cache.cache_dir if overlap_cache else None,
+                "cached": bool(overlap_cache
+                               and overlap_cache.peek(overlap_key)),
+            }
         tracer.event("manifest", **build_manifest(
-            cfg=cfg, mesh=exp.mesh,
-            extra={"program": "run",
-                   "engine": ("async" if cfg.fed.async_mode
-                              else "tp2d" if cfg.run.model_parallel > 1
-                              else "sync1d")}))
+            cfg=cfg, mesh=exp.mesh, extra=manifest_extra))
     # Estimated exchange volume per round: every client ships one model's
     # worth of floats through the aggregation (and receives the average
     # back); int8 compression quarters the f32 payload. An estimate of the
@@ -1006,6 +1062,27 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         rnd = start_round
         while rnd < cfg.fed.rounds and not stopped_early:
             take = min(chunk, cfg.fed.rounds - rnd)
+            if (overlap_exec is not None and take == chunk
+                    and chunk not in step_fns):
+                if (overlap_exec.done(overlap_key)
+                        or cfg.fed.rounds - rnd <= chunk):
+                    # Adopt the background-built executable (an AOT
+                    # ``Compiled`` is called exactly like the jit wrapper).
+                    # When no warmup round can still fit, this get() is the
+                    # one place dispatch blocks on compilation.
+                    try:
+                        step_fns[chunk] = overlap_exec.get(overlap_key)
+                    except Exception:
+                        # Background build failed; the eager compile path
+                        # below takes over at this width.
+                        registry.counter(
+                            "background_compile_failures").inc()
+                        overlap_exec = None
+                else:
+                    # Wide program still compiling: train a width-1 warmup
+                    # round meanwhile (bitwise-identical math — R width-1
+                    # chunks == one R-wide chunk).
+                    take = 1
             if take not in step_fns:
                 # First call at this chunk width: trace + lower + compile
                 # happen synchronously inside the dispatch (only execution
@@ -1126,6 +1203,10 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             halt_diverged(f"params/optimizer state after round {rnd}", rnd)
 
     finally:
+        if overlap_exec is not None:
+            # Don't wait on a background compile the run never needed
+            # (early stop before the first wide chunk).
+            overlap_exec.shutdown()
         if cfg.run.profile_dir:
             # Completion proof before finalizing the trace —
             # block_until_ready does not synchronize on the axon transport,

@@ -69,6 +69,10 @@ def build_manifest(cfg=None, mesh=None, extra: Optional[dict] = None) -> dict:
         out["device_kinds"] = sorted({d.device_kind for d in devs})
         out["process_index"] = jax.process_index()
         out["process_count"] = jax.process_count()
+        # Where this run's XLA compiles were persisted (None when the
+        # persistent compilation cache is off) — the half of "why was
+        # startup fast/slow?" the config dump alone can't answer.
+        out["compilation_cache"] = jax.config.jax_compilation_cache_dir
     except Exception:  # fedtpu: noqa[FTP102] manifest is best-effort; no backend must not kill the run
         pass
     if mesh is not None:

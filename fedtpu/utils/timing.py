@@ -67,7 +67,15 @@ def force_fetch(tree) -> float:
     return float(np.asarray(leaf))
 
 
-def compile_with_flops(step, *args):
+def program_flops(compiled) -> float:
+    """Flops from an executable's XLA cost analysis (0.0 when absent)."""
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):   # pre-0.5 jax: list of per-module dicts
+        cost = cost[0] if cost else {}
+    return float(cost.get("flops", 0.0))
+
+
+def compile_with_flops(step, *args, cache=None, key=None):
     """AOT-compile a jitted program once; return ``(compiled, flops)``.
 
     The single shared path for benchmark scripts: the returned executable is
@@ -78,12 +86,21 @@ def compile_with_flops(step, *args):
     note a ``lax.scan`` body is counted ONCE regardless of length, so for a
     scanned multi-round program this is the PER-ROUND cost. Raises when cost
     analysis is unavailable: a benchmark that cannot check its flops floor
-    must not record a number at all."""
-    compiled = step.lower(*args).compile()
-    cost = compiled.cost_analysis() or {}
-    if isinstance(cost, (list, tuple)):   # pre-0.5 jax: list of per-module dicts
-        cost = cost[0] if cost else {}
-    flops = float(cost.get("flops", 0.0))
+    must not record a number at all.
+
+    ``cache`` (a :class:`fedtpu.compilation.ProgramCache`) routes the build
+    through the serialized-executable store: a warm entry under ``key``
+    deserializes in milliseconds and carries its flops in the meta sidecar
+    (cost analysis is computed at store time)."""
+    if cache is not None:
+        if key is None:
+            raise ValueError("compile_with_flops: cache given without a key")
+        entry = cache.get_or_compile(key, step, *args, label="bench")
+        compiled = entry.compiled
+        flops = float(entry.meta.get("flops") or program_flops(compiled))
+    else:
+        compiled = step.lower(*args).compile()
+        flops = program_flops(compiled)
     if flops <= 0:
         raise RuntimeError(
             "XLA cost_analysis unavailable for this program; the flops "

@@ -9,6 +9,12 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Boxes with a TPU PJRT plugin but no TPU (or no metadata service) spend
+# minutes in libtpu's 30-try GCP metadata fetch before giving up; skip the
+# query so backend discovery fails fast. Inherited by subprocess tests
+# (test_graft_entry strips only XLA_FLAGS/JAX_PLATFORMS), whose un-pinned
+# `jax.devices()` preambles otherwise stall past the suite budget.
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +
@@ -70,6 +76,10 @@ QUICK_TESTS = {
     "test_cli.py::test_presets_listing",
     "test_cli.py::test_sweep_bad_table_path_fails_fast",
     "test_cli.py::test_run_new_aggregation_flags_reach_config",
+    "test_compilation.py::test_fingerprint_moves_with_the_program",
+    "test_compilation.py::test_executor_dedupes_blocks_and_reraises",
+    "test_compilation.py::"
+    "test_fingerprint_is_stable_across_concrete_and_abstract_args",
     "test_compress.py::test_quantize_roundtrip_error_bound",
     "test_compress.py::test_quantize_zero_delta_is_exact",
     "test_compress.py::test_quantize_preserves_extremes",

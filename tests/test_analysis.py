@@ -143,6 +143,33 @@ FIXTURES = {
                 return -x
             """,
     },
+    "FTP006": {
+        "positive": """
+            import jax
+            def sweep(fns, xs):
+                out = []
+                for fn, x in zip(fns, xs):
+                    out.append(jax.jit(fn)(x))   # wrapper rebuilt per iter
+                return out
+            """,
+        "negative": """
+            import jax
+            def make(k: int):
+                @jax.jit
+                def f(x):
+                    return x * k
+                return f
+            def sweep(step, xs):
+                # hoisted wrapper + AOT idiom: .lower on a bound callable
+                compiled = step.lower(xs[0]).compile()
+                return [compiled(x) for x in xs]
+            """,
+        "suppressed": """
+            import jax
+            def once(fn, x):
+                return jax.jit(fn)(x)  # fedtpu: noqa[FTP006] fixture
+            """,
+    },
     "FTP005": {
         "positive": """
             def f():
@@ -380,7 +407,7 @@ def test_guards_transfer_disallow_blocks_host_pulls():
 
     from fedtpu.analysis.guards import guards
 
-    y = jax.jit(lambda x: x * 2)(jnp.ones(3))
+    y = jax.jit(lambda x: x * 2)(jnp.ones(3))  # fedtpu: noqa[FTP006] one-shot warmup compile for the guard test
     y.block_until_ready()
     # "disallow" blocks implicit host->device promotion (the class of
     # accidental transfer the round loop must never perform mid-window;

@@ -121,6 +121,48 @@ def test_run_new_aggregation_flags_reach_config(monkeypatch):
     assert captured["fed"].trim_ratio == 0.2
 
 
+def test_run_compile_flags_reach_run_config(monkeypatch, tmp_path):
+    """--compilation-cache / --overlap-compile must land in RunConfig —
+    that is how run_experiment, the sweep, and library callers get the
+    persistent-cache / background-compile behavior."""
+    import fedtpu.cli as cli
+    import fedtpu.orchestration.loop as loop
+    captured = {}
+
+    def spy(cfg, verbose=True, resume=False):
+        captured["run"] = cfg.run
+
+        class R:
+            def summary(self):
+                return {}
+        return R()
+
+    monkeypatch.setattr(loop, "run_experiment", spy)
+    import os
+
+    import jax
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    cache_dir = str(tmp_path / "cc")
+    try:
+        rc = cli.main(["run", "--csv", "", "--rounds", "1",
+                       "--compilation-cache", cache_dir,
+                       "--overlap-compile", "--quiet"])
+    finally:
+        # main() applies the cache config process-globally; scope it here.
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)
+    assert rc == 0
+    assert captured["run"].compilation_cache == os.path.abspath(cache_dir)
+    assert captured["run"].overlap_compile is True
+    # Defaults stay off: no flag, no cache, no overlap.
+    rc = cli.main(["run", "--csv", "", "--rounds", "1", "--quiet"])
+    assert rc == 0
+    assert captured["run"].compilation_cache is None
+    assert captured["run"].overlap_compile is False
+
+
 def test_run_compress_end_to_end_via_cli(capsys):
     rc = main(["run", "--csv", "", "--rounds", "2", "--num-clients", "4",
                "--compress", "int8", "--quiet", "--json"])
@@ -174,7 +216,8 @@ def test_every_documented_flag_exists_in_the_parser():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     documented = set()
     for rel in ("README.md", "docs/API.md", "docs/ARCHITECTURE.md",
-                "docs/observability.md", "docs/analysis.md", "PARITY.md",
+                "docs/observability.md", "docs/analysis.md",
+                "docs/performance.md", "PARITY.md",
                 "benchmarks/RESULTS.md"):
         text = open(os.path.join(root, rel)).read()
         # Underscores ARE captured so `--dp_clip_norm`-style typos show up
@@ -185,6 +228,7 @@ def test_every_documented_flag_exists_in_the_parser():
     other_tools = {"--reps",                       # benchmarks/*.py
                    "--out",                        # bench.py result file
                    "--eval-every",                 # accuracy_parity.py
+                   "--min-speedup",                # benchmarks/compile_bench.py
                    "--xla_force_host_platform_device_count",  # XLA flag
                    "--hostfile", "--np"}           # mpirun (reference docs)
     missing = documented - known - other_tools

@@ -22,7 +22,7 @@ def _run_reduce(fn, shape, seed=0):
     def body(xb):
         return fn(xb[0], "clients", 8)[None]
 
-    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("clients"),
+    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("clients"),  # fedtpu: noqa[FTP006] one-shot test launch
                                 out_specs=P("clients")))(x)
     return np.asarray(out), np.asarray(x.sum(axis=0))
 
@@ -48,7 +48,7 @@ def test_pallas_rdma_ring_matches_global_sum(shape):
     def body(xb):
         return pallas_ring_all_reduce_sum(xb[0], "clients", 8)[None]
 
-    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("clients"),
+    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("clients"),  # fedtpu: noqa[FTP006] one-shot test launch
                                 out_specs=P("clients"),
                                 check_vma=False))(x)
     out, expected = np.asarray(out), np.asarray(x.sum(axis=0))
