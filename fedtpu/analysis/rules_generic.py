@@ -1,4 +1,4 @@
-"""Generic (non-JAX) rules: FTP005, FTP101, FTP102.
+"""Generic (non-JAX) rules: FTP005, FTP007, FTP101, FTP102.
 
 FTP005 absorbs the bare-print lint that used to live inline in
 ``tests/test_telemetry.py``: telemetry output must flow through
@@ -20,13 +20,30 @@ from fedtpu.analysis.engine import Finding, rule
 PRINT_ALLOWLIST: tuple[str, ...] = (
     "fedtpu/telemetry/log.py",
     "fedtpu/cli.py",
+    "fedtpu/resilience/supervisor.py",
+    "fedtpu/resilience/chaos.py",
     "bench.py",
 )
 
+# Modules allowed to terminate the process: the CLI surface and the
+# supervisor layer, whose exit codes ARE the restart contract
+# (docs/resilience.md). Library code must raise instead — a sys.exit
+# deep in the round loop would silently skip the checkpoint drain,
+# tracer flush, and the supervisor's rc dispatch.
+EXIT_ALLOWLIST: tuple[str, ...] = (
+    "fedtpu/cli.py",
+    "fedtpu/resilience/supervisor.py",
+    "fedtpu/resilience/chaos.py",
+)
+
+
+def _suffix_match(path: str, allowlist: tuple[str, ...]) -> bool:
+    norm = path.replace("\\", "/")
+    return any(norm.endswith(suffix) for suffix in allowlist)
+
 
 def _path_allowlisted(path: str) -> bool:
-    norm = path.replace("\\", "/")
-    return any(norm.endswith(suffix) for suffix in PRINT_ALLOWLIST)
+    return _suffix_match(path, PRINT_ALLOWLIST)
 
 
 @rule(
@@ -51,6 +68,41 @@ def check_bare_print(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
                 col=node.col_offset,
                 message="bare print(); use the telemetry logger "
                 "(fedtpu/telemetry/log.py) or a Tracer event",
+            )
+
+
+@rule(
+    "FTP007",
+    "library-exit",
+    "sys.exit()/os._exit() outside the CLI/supervisor layer; library "
+    "code must raise so checkpoint drain, tracer flush, and the "
+    "supervisor's exit-code contract stay intact.",
+)
+def check_library_exit(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
+    if _suffix_match(path, EXIT_ALLOWLIST):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = None
+        if isinstance(f, ast.Name) and f.id == "exit":
+            name = "exit"
+        elif (isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name)):
+            if f.value.id == "sys" and f.attr == "exit":
+                name = "sys.exit"
+            elif f.value.id == "os" and f.attr in ("_exit", "abort"):
+                name = f"os.{f.attr}"
+        if name:
+            yield Finding(
+                rule="FTP007",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=f"{name}() in library code bypasses checkpoint "
+                "drain and the supervisor exit-code contract "
+                "(docs/resilience.md); raise an exception instead",
             )
 
 

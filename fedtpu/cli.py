@@ -326,6 +326,18 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["pipelined_stop"] = True
     if getattr(args, "model_parallel", None) is not None:
         run_kw["model_parallel"] = args.model_parallel
+    if getattr(args, "fault_plan", None) is not None:
+        run_kw["fault_plan"] = args.fault_plan
+    if getattr(args, "on_divergence", None) is not None:
+        run_kw["on_divergence"] = args.on_divergence
+    if getattr(args, "rollback_retries", None) is not None:
+        run_kw["rollback_retries"] = args.rollback_retries
+    if getattr(args, "rollback_exclude", False):
+        run_kw["rollback_exclude"] = True
+    if getattr(args, "rollback_perturb", None) is not None:
+        run_kw["rollback_perturb"] = args.rollback_perturb
+    if getattr(args, "heartbeat", None) is not None:
+        run_kw["heartbeat_file"] = args.heartbeat
     if args.events is not None:
         run_kw["telemetry"] = dataclasses.replace(run.telemetry,
                                                   events_path=args.events)
@@ -409,6 +421,39 @@ def build_parser() -> argparse.ArgumentParser:
                        help="post-training per-client fine-tuning steps "
                             "from the final global model (personalized "
                             "metrics in the summary)")
+    # run-only resilience knobs (fedtpu.resilience; docs/resilience.md).
+    run_p.add_argument("--fault-plan", default=None, metavar="JSON",
+                       help="deterministic fault schedule: a JSON file "
+                            "path or inline JSON object (seeded; see "
+                            "docs/resilience.md for the schema)")
+    run_p.add_argument("--on-divergence", choices=["halt", "rollback"],
+                       default=None,
+                       help="non-finite guard policy: 'halt' (quarantine + "
+                            "stop, the default) or 'rollback' (restore the "
+                            "latest good checkpoint and retry; needs "
+                            "--checkpoint-dir and --checkpoint-every)")
+    run_p.add_argument("--rollback-retries", type=_nonnegative_int,
+                       default=None,
+                       help="rollback retry budget for the whole run "
+                            "(default 2); exhausted -> halt as usual")
+    run_p.add_argument("--rollback-exclude", action="store_true",
+                       help="on rollback, permanently exclude the "
+                            "offending client(s) from aggregation (mask "
+                            "weight 0; needs --weighting data_size)")
+    run_p.add_argument("--rollback-perturb", type=_nonnegative_float,
+                       default=None,
+                       help="relative parameter perturbation applied from "
+                            "the SECOND rollback retry on (default 1e-6; "
+                            "the first retry is always a pure replay)")
+    run_p.add_argument("--heartbeat", default=None, metavar="FILE",
+                       help="liveness heartbeat file the loop rewrites "
+                            "atomically every chunk ('fedtpu supervise "
+                            "--hang-timeout' watches its mtime)")
+    run_p.add_argument("--max-restarts", type=_positive_int, default=None,
+                       help="self-supervise: run as a child process "
+                            "auto-restarted with --resume up to N times on "
+                            "crash/preemption (shorthand for 'fedtpu "
+                            "supervise -- run ...')")
 
     sweep_p = sub.add_parser("sweep", help="federated hyperparameter grid")
     _add_common_overrides(sweep_p)
@@ -553,12 +598,105 @@ def build_parser() -> argparse.ArgumentParser:
     warmup_p.add_argument("--quiet", action="store_true",
                           help="suppress per-program progress lines")
 
+    # Process supervision: restart-on-crash with --resume. The parent
+    # never imports jax — it only forks children — so it stays alive
+    # through backend crashes that would take a same-process retry down.
+    sup_p = sub.add_parser("supervise",
+                           help="run a fedtpu command as a supervised "
+                                "child: auto-restart with --resume on "
+                                "crash/preemption (docs/resilience.md)")
+    sup_p.add_argument("--max-restarts", type=_nonnegative_int, default=2,
+                       help="restart budget (default 2); divergence "
+                            "(exit 3) is never restarted")
+    sup_p.add_argument("--backoff", type=_nonnegative_float, default=1.0,
+                       help="crash-restart backoff base in seconds, "
+                            "doubled per restart (default 1.0; preemption "
+                            "restarts — exit 75 — skip backoff)")
+    sup_p.add_argument("--backoff-max", type=_nonnegative_float,
+                       default=30.0,
+                       help="backoff ceiling in seconds (default 30)")
+    sup_p.add_argument("--grace", type=_nonnegative_float, default=15.0,
+                       help="seconds a SIGTERM'd child gets to drain its "
+                            "checkpoint before SIGKILL (default 15)")
+    sup_p.add_argument("--hang-timeout", type=_nonnegative_float,
+                       default=None,
+                       help="SIGKILL + restart the child when its "
+                            "--heartbeat file goes stale for this many "
+                            "seconds (default: no hang detection)")
+    sup_p.add_argument("--heartbeat", default=None, metavar="FILE",
+                       help="heartbeat file (auto-appended to 'run' "
+                            "children; required for --hang-timeout)")
+    sup_p.add_argument("--events", default=None, metavar="JSONL",
+                       help="append supervisor events (child_start/"
+                            "child_exit/restart) to this sink — point it "
+                            "at the child's --events file for one merged "
+                            "timeline")
+    sup_p.add_argument("--quiet", action="store_true",
+                       help="suppress supervisor status lines")
+    sup_p.add_argument("child", nargs=argparse.REMAINDER,
+                       help="the supervised fedtpu command, after '--': "
+                            "e.g. fedtpu supervise -- run --rounds 100 "
+                            "--checkpoint-dir d --checkpoint-every 10")
+
+    # Chaos drill: execute the fault scenario matrix end-to-end and
+    # report per-scenario survival/recovery. Children are subprocesses;
+    # the parent stays jax-free like `supervise`.
+    chaos_p = sub.add_parser("chaos",
+                             help="execute the resilience scenario matrix "
+                                  "(kill/preempt/NaN/dropout/straggler) "
+                                  "and report per-scenario recovery")
+    chaos_p.add_argument("--scenarios", default=None, metavar="A,B",
+                         help="comma-separated subset of: sigkill, "
+                              "preempt, nan_rollback, dropout, straggler "
+                              "(default: all)")
+    chaos_p.add_argument("--rounds", type=_positive_int, default=10,
+                         help="rounds per scenario run (default 10)")
+    chaos_p.add_argument("--num-clients", type=_positive_int, default=4,
+                         help="synthetic clients per run (default 4)")
+    chaos_p.add_argument("--workdir", default=None, metavar="DIR",
+                         help="scenario artifact directory (default: a "
+                              "temp dir, removed unless --keep-artifacts)")
+    chaos_p.add_argument("--keep-artifacts", action="store_true",
+                         help="keep per-scenario checkpoints/metrics/"
+                              "events for inspection")
+    chaos_p.add_argument("--timeout", type=_positive_int, default=600,
+                         help="per-child-run timeout in seconds "
+                              "(default 600)")
+    chaos_p.add_argument("--platform", choices=["default", "cpu"],
+                         default="cpu",
+                         help="platform for the child runs (default cpu: "
+                              "the matrix is a correctness drill, not a "
+                              "perf run)")
+    chaos_p.add_argument("--json", action="store_true",
+                         help="print the matrix report as one JSON line")
+    chaos_p.add_argument("--quiet", action="store_true",
+                         help="suppress per-scenario progress lines")
+
     sub.add_parser("presets", help="list shipped presets")
     return parser
 
 
+def _strip_flag(argv, flag):
+    """argv minus ``flag`` (both ``--f V`` and ``--f=V`` spellings)."""
+    out, skip = [], False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok == flag:
+            skip = True
+            continue
+        if tok.startswith(flag + "="):
+            continue
+        out.append(tok)
+    return out
+
+
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    # The raw argv is kept so `run --max-restarts N` can re-issue THIS
+    # exact invocation as a supervised child (with the flag stripped).
+    raw_argv = list(argv) if argv is not None else sys.argv[1:]
+    args = build_parser().parse_args(raw_argv)
 
     if args.cmd == "presets":
         for name, preset in sorted(PRESETS.items()):
@@ -597,6 +735,51 @@ def main(argv=None) -> int:
             with open(args.prometheus, "w") as f:
                 f.write(prom)
         return 0
+
+    if args.cmd == "supervise":
+        # Before the platform pin: the supervisor parent never imports
+        # jax — it only forks children, so it survives backend crashes.
+        from fedtpu.resilience.supervisor import supervise
+        child = list(args.child)
+        if child and child[0] == "--":
+            child = child[1:]
+        if not child:
+            raise SystemExit(
+                "fedtpu supervise: give the child command after '--', "
+                "e.g. fedtpu supervise -- run --rounds 100 "
+                "--checkpoint-dir d --checkpoint-every 10")
+        return supervise(child, max_restarts=args.max_restarts,
+                         backoff_base=args.backoff,
+                         backoff_max=args.backoff_max,
+                         grace=args.grace, hang_timeout=args.hang_timeout,
+                         heartbeat=args.heartbeat, events=args.events,
+                         verbose=not args.quiet)
+
+    if args.cmd == "chaos":
+        # Also jax-free in the parent: every scenario run is a child
+        # process (its --platform applies to the children, not us).
+        from fedtpu.resilience.chaos import run_chaos
+        scenarios = ([s.strip() for s in args.scenarios.split(",")
+                      if s.strip()] if args.scenarios else None)
+        report = run_chaos(scenarios=scenarios, rounds=args.rounds,
+                           num_clients=args.num_clients,
+                           workdir=args.workdir,
+                           keep_artifacts=args.keep_artifacts,
+                           timeout=args.timeout, platform=args.platform,
+                           verbose=not args.quiet)
+        if args.json:
+            print(json.dumps(report, default=float))
+        return 0 if report["ok"] else 1
+
+    if args.cmd == "run" and getattr(args, "max_restarts", None):
+        # Self-supervision shorthand: re-issue this exact run as a
+        # supervised child. Stripping the flag is what stops the child
+        # from recursing into another supervisor.
+        from fedtpu.resilience.supervisor import supervise
+        child = _strip_flag(raw_argv, "--max-restarts")
+        return supervise(child, max_restarts=args.max_restarts,
+                         heartbeat=args.heartbeat, events=args.events,
+                         verbose=not args.quiet)
 
     if getattr(args, "platform", "default") == "cpu":
         # Before ANY backend touch (including the compilation-cache config
@@ -661,9 +844,24 @@ def main(argv=None) -> int:
 
     if args.cmd == "run":
         from fedtpu.orchestration.loop import run_experiment
-        result = run_experiment(cfg, verbose=not args.quiet,
-                                resume=args.resume)
+        from fedtpu.resilience.supervisor import (EXIT_DIVERGED,
+                                                  EXIT_PREEMPTED, Preempted)
+        try:
+            result = run_experiment(cfg, verbose=not args.quiet,
+                                    resume=args.resume)
+        except Preempted as p:
+            # SIGTERM drain completed: state is checkpointed and the run
+            # is resumable — the supervisor contract's "restart me" code.
+            if args.json:
+                print(json.dumps({"preempted": True, "round": p.round}))
+            return EXIT_PREEMPTED
         summary = result.summary()
+        if summary.get("diverged"):
+            # Divergence halt is deterministic — replaying it cannot
+            # help, so the exit code tells supervisors NOT to restart.
+            if args.json:
+                print(json.dumps(summary, default=float))
+            return EXIT_DIVERGED
     elif args.cmd == "sweep":
         from fedtpu.sweep.grid import run_grid_search, save_best_weights
         # Fail fast on BOTH output paths before the (minutes-long) sweep —

@@ -324,6 +324,29 @@ class RunConfig:
     overlap_compile: bool = False
     # Structured telemetry (span/event sink, manifest, logger level).
     telemetry: TelemetryConfig = TelemetryConfig()
+    # Resilience (fedtpu.resilience): deterministic fault injection — a
+    # JSON file path or inline JSON string (kept as str so the config
+    # stays frozen/hashable); None = no faults. See docs/resilience.md.
+    fault_plan: Optional[str] = None
+    # What the non-finite guard does: 'halt' (quarantine + stop, the
+    # pre-resilience behavior) or 'rollback' (restore the latest good
+    # checkpoint and retry — requires checkpoint_dir + checkpoint_every,
+    # incompatible with pipelined_stop).
+    on_divergence: str = "halt"
+    # Rollback retry budget for the whole run; exhausted -> halt as today.
+    rollback_retries: int = 2
+    # On rollback, permanently zero the offending clients' sample masks
+    # (exact weight-0 exclusion under weighting='data_size') and drop
+    # their pending faults. Sync engines + data_size weighting only.
+    rollback_exclude: bool = False
+    # Relative parameter perturbation (leaf * (1 + scale*U[-1,1])) applied
+    # from the SECOND rollback retry on — the first retry is a pure replay
+    # (transient faults recover bitwise); a deterministic re-divergence
+    # needs a different restart point. 0 disables.
+    rollback_perturb: float = 1e-6
+    # Liveness heartbeat file the loop rewrites atomically every chunk
+    # (written by process 0 only); monitored by `fedtpu supervise`.
+    heartbeat_file: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)

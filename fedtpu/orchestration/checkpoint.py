@@ -74,7 +74,13 @@ def save_checkpoint(directory: str, state, history: dict, step: int,
     # BEFORE the client-count comparison — a cross-engine resume must fail
     # on engine kind, not on whichever structural mismatch orbax hits first.
     engine_async = 1 if (isinstance(state, dict) and "anchors" in state) else 0
-    meta = {"history": {k: np.asarray(v) for k, v in history.items()},
+    # Zero-length metric arrays are dropped: tensorstore cannot commit an
+    # empty chunk (orbax rejects the save as "missing params"), and the
+    # loop's restore paths already treat an absent key as an empty
+    # history. This is what makes the round-0 restore point — saved
+    # BEFORE any metrics exist, for ``on_divergence=rollback`` — storable.
+    meta = {"history": {k: np.asarray(v) for k, v in history.items()
+                        if np.asarray(v).size},
             "step": np.asarray(step),
             "num_clients": np.asarray(num_clients),
             "engine_async": np.asarray(engine_async)}
@@ -205,7 +211,8 @@ def load_checkpoint_raw(directory: str, step: Optional[int] = None
     ckptr = ocp.PyTreeCheckpointer()
     state = ckptr.restore(os.path.join(path, "state"))
     meta = ckptr.restore(os.path.join(path, "meta"))
-    history = {k: list(np.asarray(v)) for k, v in meta["history"].items()}
+    history = {k: list(np.asarray(v))
+               for k, v in (meta.get("history") or {}).items()}
     default_registry().counter("checkpoint_restores").inc()
     return state, history, int(np.asarray(meta["step"]))
 
@@ -235,6 +242,36 @@ def peek_num_clients(directory: str, step: Optional[int] = None
     callers then fall back to :func:`load_checkpoint_raw`."""
     nc = load_meta(directory, step).get("num_clients")
     return None if nc is None else int(np.asarray(nc))
+
+
+def load_checkpoint_fallback(directory: str, sharding=None, state_like=None
+                             ) -> Tuple[dict, dict, int]:
+    """``load_checkpoint`` of the NEWEST checkpoint that actually
+    restores, walking complete steps newest-first past corrupt rounds.
+
+    ``_is_complete`` only proves both items were committed — it cannot
+    see in-place byte corruption (a dying disk, a partial overwrite; the
+    ``ckpt_corrupt`` fault in fedtpu.resilience.faults manufactures
+    exactly this). A restore failure on the latest round must not strand
+    a resumable run when an older good round exists, so each failure is
+    warned about, counted (``checkpoint_restore_corrupt``), and skipped.
+    Raises FileNotFoundError when no checkpoint loads at all."""
+    steps = complete_steps(directory)
+    last_err: Optional[Exception] = None
+    for step in reversed(steps):
+        try:
+            return load_checkpoint(directory, step=step, sharding=sharding,
+                                   state_like=state_like)
+        except Exception as e:
+            last_err = e
+            default_registry().counter("checkpoint_restore_corrupt").inc()
+            warnings.warn(f"checkpoint round {step} failed to restore "
+                          f"({type(e).__name__}: {e}); falling back to the "
+                          "previous round", RuntimeWarning)
+    raise FileNotFoundError(
+        f"no restorable checkpoint under {directory} "
+        f"({len(steps)} complete-looking round(s) all failed to load)"
+    ) from last_err
 
 
 def load_checkpoint(directory: str, step: Optional[int] = None,
@@ -303,6 +340,6 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
     if had_marker:
         state["shared_start"] = ()
     history = {k: list(np.asarray(v))
-               for k, v in meta["history"].items()}
+               for k, v in (meta.get("history") or {}).items()}
     default_registry().counter("checkpoint_restores").inc()
     return state, history, int(np.asarray(meta["step"]))

@@ -40,6 +40,8 @@ import dataclasses
 import json
 import math
 import os
+import signal
+import threading
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -57,6 +59,7 @@ from fedtpu.orchestration.checkpoint import (complete_steps,
                                              retain_checkpoints,
                                              save_checkpoint)
 from fedtpu.orchestration.privacy import PrivacyLedger
+from fedtpu.resilience.supervisor import Preempted, write_heartbeat
 from fedtpu.parallel.mesh import make_mesh, client_sharding
 from fedtpu.telemetry import (TelemetryLogger, build_manifest,
                               default_registry, install_compile_probe,
@@ -498,6 +501,14 @@ def _unstack_metrics(metrics: dict, take: int) -> List[dict]:
     return [jax.tree.map(lambda v: v[j], metrics) for j in range(take)]
 
 
+def _drop_tail(lst: list, n: int) -> None:
+    """Drop the last ``n`` entries in place (no-op for n <= 0; clamped) —
+    the rollback truncation primitive for the in-memory-only histories,
+    which may hold FEWER entries than rounds when the run resumed."""
+    if n > 0:
+        del lst[max(0, len(lst) - n):]
+
+
 def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                    verbose: bool = True,
                    resume: bool = False) -> ExperimentResult:
@@ -524,6 +535,37 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     #   * control flow (early stop, divergence, round counters) stays
     #     identical on every process because it is derived from the
     #     replicated metrics.
+    # Resilience knob validation FIRST — before any build/compile work,
+    # so a bad combination fails in milliseconds, not after a compile.
+    if cfg.run.on_divergence not in ("halt", "rollback"):
+        raise ValueError("on_divergence must be 'halt' or 'rollback', got "
+                         f"{cfg.run.on_divergence!r}")
+    if cfg.run.on_divergence == "rollback":
+        if not (cfg.run.checkpoint_dir and cfg.run.checkpoint_every > 0):
+            raise ValueError("on_divergence='rollback' needs a restore "
+                             "point: set checkpoint_dir and "
+                             "checkpoint_every > 0")
+        if cfg.run.pipelined_stop:
+            raise ValueError(
+                "on_divergence='rollback' is incompatible with "
+                "pipelined_stop: the pipelined divergence guard fires one "
+                "in-flight chunk late, after the restore point's successor "
+                "chunk already dispatched")
+    if cfg.run.rollback_exclude:
+        if cfg.run.on_divergence != "rollback":
+            raise ValueError("rollback_exclude requires "
+                             "on_divergence='rollback'")
+        if cfg.fed.async_mode:
+            raise ValueError("rollback_exclude requires the synchronous "
+                             "engines: exclusion zeroes the sample mask, "
+                             "which the async arrival process ignores")
+        if cfg.fed.weighting != "data_size":
+            raise ValueError(
+                "rollback_exclude requires weighting='data_size': a "
+                "zero-mask client has aggregation weight mask.sum()=0 only "
+                "under data-size weighting (under 'uniform' it would still "
+                "average in at weight 1)")
+
     multiproc = jax.process_count() > 1
     io_proc = jax.process_index() == 0
     verbose = verbose and io_proc
@@ -546,6 +588,52 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     with tracer.span("build"):
         exp = build_experiment(cfg, dataset)
     state, batch, eval_step, ds = exp.state, exp.batch, exp.eval_step, exp.dataset
+
+    # Supervisor restart generation (fedtpu.resilience.supervisor sets
+    # FEDTPU_RESTARTS on every child): recorded in the manifest, and it
+    # disarms the fault plan's once-per-run kill faults — a restarted run
+    # resumes BELOW the fault round and would re-kill itself forever.
+    restart_count = int(os.environ.get("FEDTPU_RESTARTS", "0") or 0)
+
+    injector = None
+    if cfg.run.fault_plan:
+        from fedtpu.resilience.faults import FaultInjector, FaultPlan
+        plan = FaultPlan.load(cfg.run.fault_plan,
+                              num_clients=cfg.shard.num_clients,
+                              rounds=cfg.fed.rounds)
+        injector = FaultInjector(plan, restart_count=restart_count,
+                                 tracer=tracer, registry=registry,
+                                 process_index=jax.process_index())
+        log.info(f"Fault plan {plan.digest}: {len(plan.faults)} fault(s), "
+                 f"{injector.armed_count} armed"
+                 + (f" (restart {restart_count})" if restart_count else "")
+                 + ".")
+
+    # Preemption drain: SIGTERM (the cloud's eviction notice, and the
+    # supervisor's forwarded stop) sets a flag the loop-top check turns
+    # into checkpoint + Preempted (exit code 75 via the CLI). Installed
+    # only when there is somewhere to drain TO, and only on the main
+    # thread (signal.signal's requirement). Multihost preemption assumes
+    # the signal reaches every process (the TPU maintenance-event
+    # convention) — the drain save is a collective.
+    preempt = {"sig": None}
+    _prev_term = None
+    if (cfg.run.checkpoint_dir
+            and threading.current_thread() is threading.main_thread()):
+        def _on_term(signum, frame):
+            preempt["sig"] = signum
+        _prev_term = signal.signal(signal.SIGTERM, _on_term)
+
+    heartbeat = cfg.run.heartbeat_file if io_proc else None
+
+    def _beat(status: str, rnd: int) -> None:
+        """Liveness heartbeat (atomic rewrite, process 0 only): the
+        supervisor's --hang-timeout reads its mtime."""
+        if heartbeat:
+            write_heartbeat(heartbeat, status=status, round=rnd,
+                            restarts=restart_count)
+
+    _beat("starting", 0)
 
     # Overlap compile (fedtpu.compilation): the rounds_per_step-wide chunk
     # program builds on a background thread — from abstract avals, through
@@ -591,7 +679,15 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         manifest_extra = {"program": "run",
                           "engine": ("async" if cfg.fed.async_mode
                                      else "tp2d" if cfg.run.model_parallel > 1
-                                     else "sync1d")}
+                                     else "sync1d"),
+                          # Resilience attribution: which restart of a
+                          # supervised run wrote this sink, under which
+                          # exact fault schedule (digest of the
+                          # MATERIALIZED plan, probabilistic entries
+                          # already expanded).
+                          "restarts": restart_count}
+        if injector is not None:
+            manifest_extra["fault_plan"] = injector.plan.digest
         if overlap_key is not None:
             # Cache directory + hit/miss state for the run's main program
             # (peek: no deserialization at manifest time).
@@ -644,8 +740,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             "checkpoint_dir at a clean directory.")
     if resume and cfg.run.checkpoint_dir:
         from fedtpu.orchestration.checkpoint import (
-            latest_step, load_checkpoint, load_checkpoint_raw, load_meta,
-            saved_num_clients)
+            latest_step, load_checkpoint_fallback, load_checkpoint_raw,
+            load_meta, saved_num_clients)
         if latest_step(cfg.run.checkpoint_dir) is not None:
             # ONE meta read serves elastic detection AND the DP RDP-curve
             # restore below; only a count MISMATCH (or a pre-num_clients
@@ -682,8 +778,17 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             if saved_c == cfg.shard.num_clients:
                 # Per-leaf shardings come from the live state template, so
                 # the 2-D engine's tensor-parallel layout survives resume.
-                state, restored_history, start_round = load_checkpoint(
-                    cfg.run.checkpoint_dir, state_like=state)
+                # Fallback restore: corrupt-on-disk rounds pass the commit
+                # check but fail to load — walk back to the newest round
+                # that actually restores instead of stranding the run.
+                state, restored_history, start_round = \
+                    load_checkpoint_fallback(cfg.run.checkpoint_dir,
+                                             state_like=state)
+                if start_round != int(np.asarray(restored_meta["step"])):
+                    # The ledger (DP RDP curve) must come from the round
+                    # actually restored, not the corrupt latest.
+                    restored_meta = load_meta(cfg.run.checkpoint_dir,
+                                              step=start_round)
                 log.info(f"Resumed from checkpoint at round {start_round}.")
             else:
                 if ("anchors" in state) != ("anchors" in raw):
@@ -838,6 +943,98 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         stopped_early = True
         diverged = True
 
+    # --- Divergence rollback (cfg.run.on_divergence == 'rollback') ----
+    # The retry budget is per RUN (not per incident): a run that keeps
+    # diverging must eventually halt, and a single monotone counter is
+    # the property the supervisor/report can reason about.
+    rollback = {"attempts": 0, "resume_at": None}
+    excluded: set = set()
+
+    def _offending_clients(m, loss_row) -> tuple:
+        """Clients with a non-finite loss or per-client metric this
+        round — the rollback_exclude candidates."""
+        bad = ~np.isfinite(np.asarray(loss_row))
+        for k in METRIC_NAMES:
+            bad = bad | ~np.isfinite(np.asarray(m["per_client"][k]))
+        return tuple(int(c) for c in np.nonzero(bad)[0])
+
+    def try_rollback(reason: str, label_round: int, offenders=()) -> bool:
+        """Restore the newest loadable checkpoint, truncate every history
+        to it, optionally exclude the offending clients, and tell the
+        loop to re-enter at the restored round. Returns False — caller
+        halts as before — when the policy is off, the retry budget is
+        spent, or nothing restores. The first retry is a PURE replay
+        (transient faults recover bitwise — round-keyed randomness makes
+        the replayed rounds identical); from the second on, params are
+        perturbed by rollback_perturb to move off a deterministic
+        re-divergence."""
+        nonlocal state, prev_metric, termination_count, rounds_run
+        if cfg.run.on_divergence != "rollback":
+            return False
+        if rollback["attempts"] >= cfg.run.rollback_retries:
+            log.warning("Rollback budget exhausted "
+                        f"({cfg.run.rollback_retries}); halting.")
+            return False
+        from fedtpu.orchestration.checkpoint import load_checkpoint_fallback
+        try:
+            state2, hist2, j = load_checkpoint_fallback(
+                cfg.run.checkpoint_dir, state_like=state)
+        except FileNotFoundError:
+            return False
+        rollback["attempts"] += 1
+        state = state2
+        # The divergent rounds' entries were appended BEFORE the guard
+        # fired: the client-mean history comes back from the checkpoint
+        # (authoritative through round j); the in-memory-only histories
+        # drop exactly the rounds past j they hold.
+        drop = max(0, rounds_run - j)
+        for k in METRIC_NAMES:
+            history[k] = list(hist2.get(k, []))
+            _drop_tail(pooled_hist[k], drop)
+            _drop_tail(per_client_hist[k], drop)
+        _drop_tail(losses, drop)
+        _drop_tail(sec_per_round, drop)
+        _drop_tail(staleness_hist, drop)
+        if cfg.run.eval_test_every:
+            edrop = sum(1 for rr in range(j + 1, rounds_run + 1)
+                        if rr % cfg.run.eval_test_every == 0)
+            for k in METRIC_NAMES:
+                _drop_tail(test_hist[k], edrop)
+        rounds_run = j
+        prev_metric = ([history[k][-1] for k in METRIC_NAMES]
+                       if history[METRIC_NAMES[0]] else None)
+        termination_count = cfg.fed.termination_patience
+        if cfg.run.rollback_exclude and offenders:
+            fresh = sorted(set(offenders) - excluded)
+            if fresh:
+                excluded.update(fresh)
+                from fedtpu.resilience.faults import drop_clients
+                batch["mask"] = drop_clients(batch["mask"], fresh)
+                if injector is not None:
+                    # A departed client cannot re-inject: drop its
+                    # still-armed faults, or a sticky NaN source would
+                    # defeat the retry (NaN*0 still poisons a psum).
+                    injector.exclude(fresh)
+                tracer.event("exclusion", round=j, clients=list(fresh))
+                registry.counter("clients_excluded").inc(len(fresh))
+                log.warning(f"Excluding diverging client(s) {fresh} from "
+                            "aggregation (mask weight 0) for the retry.")
+        if rollback["attempts"] >= 2 and cfg.run.rollback_perturb > 0:
+            from fedtpu.resilience.faults import perturb_params
+            state["params"] = perturb_params(state["params"],
+                                             rollback["attempts"],
+                                             cfg.run.rollback_perturb)
+        tracer.event("rollback", round=label_round, restored_round=j,
+                     attempt=rollback["attempts"], reason=reason,
+                     excluded=sorted(excluded))
+        registry.counter("rollbacks").inc()
+        log.warning(f"Non-finite {reason}; rolled back to round {j} "
+                    f"(attempt {rollback['attempts']}/"
+                    f"{cfg.run.rollback_retries}).")
+        timer.lap()        # restore time must not pollute sec/round
+        rollback["resume_at"] = j
+        return True
+
     if restored_history is not None:
         for k in METRIC_NAMES:
             history[k] = list(restored_history.get(k, []))
@@ -874,6 +1071,15 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             retain_checkpoints(cfg.run.checkpoint_dir,
                                cfg.run.keep_checkpoints,
                                protect=(best_saved[1],))
+
+    if (cfg.run.on_divergence == "rollback"
+            and not complete_steps(cfg.run.checkpoint_dir)):
+        # Rollback's worst case — divergence before the first periodic
+        # save — still needs a restore point: persist the initial state
+        # as round `start_round` (0 for a fresh run). Collective: every
+        # process calls (the condition is deterministic).
+        save_checkpoint(cfg.run.checkpoint_dir, state, history, start_round,
+                        extra_meta=ledger.checkpoint_meta(start_round))
 
     ckpt_every = cfg.run.checkpoint_every
     chunk = max(1, cfg.run.rounds_per_step)
@@ -1005,8 +1211,14 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 if cfg.run.halt_on_nonfinite and not (
                         np.all(np.isfinite(cur))
                         and np.all(np.isfinite(losses[-1]))):
-                    halt_diverged(f"loss/metrics at round {r + 1}",
-                                  state_round)
+                    # Rollback policy first (restores + truncates + sets
+                    # resume_at; the while loop re-enters at the restored
+                    # round); only when it declines does the run halt.
+                    if not try_rollback(
+                            f"loss/metrics at round {r + 1}", r + 1,
+                            offenders=_offending_clients(m, losses[-1])):
+                        halt_diverged(f"loss/metrics at round {r + 1}",
+                                      state_round)
                     sp_stop.end()
                     return
 
@@ -1061,7 +1273,34 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         pending = None                      # (rnd0, take, metrics) in flight
         rnd = start_round
         while rnd < cfg.fed.rounds and not stopped_early:
+            if preempt["sig"] is not None:
+                # Graceful preemption drain: finish any in-flight chunk,
+                # checkpoint (unless the state is poisoned — a NaN drain
+                # checkpoint would resume straight back into divergence),
+                # and exit through the Preempted contract (code 75, the
+                # supervisor restarts with --resume).
+                if pending is not None:
+                    process_chunk(*pending, state_round=rnd)
+                    pending = None
+                if not stopped_early:
+                    if not (cfg.run.halt_on_nonfinite and state_poisoned()):
+                        with tracer.span("checkpoint", round=rnd):
+                            save_checkpoint(
+                                cfg.run.checkpoint_dir, state, history, rnd,
+                                extra_meta=ledger.checkpoint_meta(rnd))
+                            retain_after_save(rnd)
+                    tracer.event("preempted", round=rnd)
+                    registry.counter("preemptions").inc()
+                    log.warning(f"SIGTERM: drained checkpoint at round "
+                                f"{rnd}; exiting for resume (preempted).")
+                    _beat("preempted", rnd)
+                    raise Preempted(rnd)
+                break
             take = min(chunk, cfg.fed.rounds - rnd)
+            if injector is not None:
+                # A fault round must run as its own width-1 dispatch so
+                # pre/post_round bracket exactly that round.
+                take = injector.chunk_limit(rnd, take)
             if (overlap_exec is not None and take == chunk
                     and chunk not in step_fns):
                 if (overlap_exec.done(overlap_key)
@@ -1083,6 +1322,9 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     # round meanwhile (bitwise-identical math — R width-1
                     # chunks == one R-wide chunk).
                     take = 1
+            if injector is not None:
+                injector.pre_round(rnd, state, batch,
+                                   checkpoint_dir=cfg.run.checkpoint_dir)
             if take not in step_fns:
                 # First call at this chunk width: trace + lower + compile
                 # happen synchronously inside the dispatch (only execution
@@ -1093,6 +1335,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     state, metrics = get_step(take)(state, batch)
             else:
                 state, metrics = get_step(take)(state, batch)
+            if injector is not None:
+                # After dispatch (the launched chunk holds its own array
+                # references): restore the pre-fault mask so every later
+                # round is bitwise-identical to an unfaulted run.
+                injector.post_round(rnd, batch)
             if pipelined:
                 if pending is not None:
                     # The current `state` is the just-dispatched chunk's
@@ -1102,6 +1349,16 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             else:
                 process_chunk(rnd, take, metrics)
             rnd += take
+
+            if rollback["resume_at"] is not None:
+                # A divergence rolled back mid-chunk-processing: re-enter
+                # the loop at the restored round (state/history already
+                # rewound by try_rollback).
+                rnd = rollback["resume_at"]
+                rollback["resume_at"] = None
+                _beat("running", rnd)
+                continue
+            _beat("running", rnd)
 
             if stopped_early:
                 # The chunk overshot the stop round; don't checkpoint or eval the
@@ -1147,6 +1404,14 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             if cfg.run.halt_on_nonfinite \
                     and (not pipelined or ckpt_due or eval_due) \
                     and state_poisoned():
+                # Offenders unknown here (the poison shows in the full
+                # state, not a per-client metric) — rollback without
+                # exclusion; halt when the policy declines.
+                if try_rollback(
+                        f"params/optimizer state after round {rnd}", rnd):
+                    rnd = rollback["resume_at"]
+                    rollback["resume_at"] = None
+                    continue
                 halt_diverged(f"params/optimizer state after round {rnd}",
                               rnd)
                 break
@@ -1203,6 +1468,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             halt_diverged(f"params/optimizer state after round {rnd}", rnd)
 
     finally:
+        if _prev_term is not None:
+            signal.signal(signal.SIGTERM, _prev_term)
         if overlap_exec is not None:
             # Don't wait on a background compile the run never needed
             # (early stop before the first wide chunk).
@@ -1287,7 +1554,9 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                      f"{dp['noise_multiplier']}, sampling rate "
                      f"{dp['sampling_rate']}, {dp['rounds']} rounds; RDP "
                      f"order {dp['rdp_order']}{notes})")
+    _beat("diverged" if diverged else "done", rounds_run)
     tracer.event("run_end", round=rounds_run, stopped_early=stopped_early,
-                 diverged=diverged, rounds_trained=result.rounds_trained)
+                 diverged=diverged, rounds_trained=result.rounds_trained,
+                 restarts=restart_count, rollbacks=rollback["attempts"])
     tracer.close()
     return result

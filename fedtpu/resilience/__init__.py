@@ -1,0 +1,31 @@
+"""fedtpu.resilience: deterministic fault injection, supervised restart,
+and divergence rollback.
+
+The reference loses everything on any failure; fedtpu's loop before this
+subsystem only *detected* failure (NaN halt + emergency checkpoint). This
+package makes failure a first-class, testable input:
+
+* :mod:`fedtpu.resilience.faults` — a seeded, fully deterministic
+  FaultPlan (JSON-driven schedule of client dropout, straggler delay, NaN
+  corruption, process kill, checkpoint corruption) applied inside the
+  round loop via ``RunConfig.fault_plan`` / ``fedtpu run --fault-plan``.
+* :mod:`fedtpu.resilience.supervisor` — the exit-code contract
+  (0 done / 3 diverged / 75 preempted), heartbeat file, and
+  ``fedtpu supervise`` auto-restart with ``--resume`` under bounded
+  exponential backoff.
+* :mod:`fedtpu.resilience.chaos` — ``fedtpu chaos``: a scenario matrix
+  (SIGKILL, preemption, NaN rollback, dropout, straggler) with
+  per-scenario survival/recovery reporting.
+
+See docs/resilience.md for the fault taxonomy and recovery semantics.
+"""
+
+from fedtpu.resilience.supervisor import (EXIT_DIVERGED, EXIT_OK,
+                                          EXIT_PREEMPTED, Preempted,
+                                          read_heartbeat, supervise,
+                                          write_heartbeat)
+
+__all__ = [
+    "EXIT_OK", "EXIT_DIVERGED", "EXIT_PREEMPTED", "Preempted",
+    "read_heartbeat", "write_heartbeat", "supervise",
+]

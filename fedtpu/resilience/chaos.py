@@ -1,0 +1,219 @@
+"""``fedtpu chaos``: execute the resilience scenario matrix end to end.
+
+Each scenario runs the SAME small synthetic training job twice — once
+uninterrupted (the baseline, shared across scenarios) and once with a
+deterministic fault plan (fedtpu.resilience.faults), supervised where
+the fault kills the process — then checks the recovery contract:
+
+  sigkill       SIGKILL mid-round; ``supervise`` restarts with --resume.
+                Survive + per-round metric history bitwise == baseline.
+  preempt       SIGTERM mid-round; the loop drains a checkpoint and
+                exits 75; restart without backoff. Same bar as sigkill.
+  nan_rollback  NaN poisoned into one client's update; ``--on-divergence
+                rollback`` restores the last good checkpoint and replays.
+                Survive + history bitwise == baseline (the replay is
+                round-keyed, so recovery is exact, not approximate).
+  dropout       One client's mask zeroed for one round. Survive, prefix
+                history bitwise == baseline, and the faulted round MUST
+                differ (a dropout that changes nothing isn't a dropout).
+  straggler     One client sleeps mid-round. Survive + history bitwise
+                == baseline (wall-clock only; the math is untouched).
+
+"History" is the ``--metrics-jsonl`` per-round record with timing
+stripped. Restarted/rolled-back runs append re-executed rounds to the
+same sink, so the comparison takes the LAST record per round — exactly
+the run's final story.
+
+Every child is a subprocess (``python -m fedtpu.cli``): the parent stays
+jax-free and survives whatever the scenario does to the child. Restart
+and rollback counts are read back from the shared ``--events`` sink via
+fedtpu.telemetry.report — the matrix doubles as an end-to-end test of
+the resilience reporting path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import List, Optional, Sequence
+
+SCENARIOS = ("sigkill", "preempt", "nan_rollback", "dropout", "straggler")
+
+# Metric-history fields compared across runs (sec_per_round is wall
+# clock — the one thing faults are ALLOWED to change).
+_HIST_KEYS = ("client_mean", "pooled", "loss_mean")
+
+
+def _fault_round(rounds: int) -> int:
+    """Mid-run, 1-based — late enough that a checkpoint precedes it,
+    early enough that recovery has rounds left to prove itself on."""
+    return max(2, rounds // 2 + 1)
+
+
+def _plan(rounds: int, kind: str) -> str:
+    k = _fault_round(rounds)
+    fault = {
+        "sigkill": {"kind": "process_kill", "round": k,
+                    "signal": "SIGKILL"},
+        "preempt": {"kind": "process_kill", "round": k,
+                    "signal": "SIGTERM"},
+        "nan_rollback": {"kind": "nan_update", "round": k, "clients": [1]},
+        "dropout": {"kind": "client_dropout", "round": k, "clients": [1]},
+        "straggler": {"kind": "straggler", "round": k, "clients": [0],
+                      "delay_s": 0.25},
+    }[kind]
+    return json.dumps({"seed": 0, "faults": [fault]})
+
+
+def _child_env() -> dict:
+    # Hermetic CPU children (the CLI's --platform does the real pin;
+    # stripping mirrors tests/test_chaos_resume.py).
+    return {k: v for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+
+def _run_args(workdir: str, tag: str, rounds: int, num_clients: int,
+              platform: str) -> List[str]:
+    return ["run", "--csv", "", "--platform", platform,
+            "--rounds", str(rounds), "--num-clients", str(num_clients),
+            "--hidden-sizes", "16", "--quiet", "--json",
+            "--metrics-jsonl", os.path.join(workdir, f"{tag}.metrics.jsonl"),
+            "--events", os.path.join(workdir, f"{tag}.events.jsonl")]
+
+
+def _history(path: str) -> dict:
+    """round -> timing-stripped metric record, LAST occurrence winning
+    (restart/rollback replays re-append the rounds they redo)."""
+    out: dict = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue                      # torn final line from a kill
+            out[rec["round"]] = {k: rec[k] for k in _HIST_KEYS if k in rec}
+    return out
+
+
+def _resilience(events_path: str) -> dict:
+    from fedtpu.telemetry.report import aggregate, load_events
+    events, bad = load_events(events_path)
+    return aggregate(events, malformed=bad).get("resilience") or {}
+
+
+def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
+                 num_clients: int, platform: str, timeout: int) -> dict:
+    """One scenario run + verdict row (see module docstring for bars)."""
+    ck = os.path.join(workdir, f"{name}.ck")
+    run_args = _run_args(workdir, name, rounds, num_clients, platform)
+    run_args += ["--fault-plan", _plan(rounds, name),
+                 "--checkpoint-dir", ck, "--checkpoint-every", "2"]
+    if name == "nan_rollback":
+        run_args += ["--on-divergence", "rollback", "--rollback-retries", "2"]
+    if name in ("sigkill", "preempt"):
+        argv = ["supervise", "--max-restarts", "2", "--events",
+                os.path.join(workdir, f"{name}.events.jsonl"),
+                "--", *run_args]
+    else:
+        argv = run_args
+    out = subprocess.run([sys.executable, "-m", "fedtpu.cli", *argv],
+                         env=_child_env(), capture_output=True, text=True,
+                         timeout=timeout)
+
+    hist = _history(os.path.join(workdir, f"{name}.metrics.jsonl"))
+    res = _resilience(os.path.join(workdir, f"{name}.events.jsonl"))
+    k = _fault_round(rounds)
+    prefix_ok = all(hist.get(r) == baseline.get(r) for r in range(1, k))
+    full_ok = (sorted(hist) == sorted(baseline)
+               and all(hist[r] == baseline[r] for r in hist))
+    if name == "dropout":
+        # The dropped round must CHANGE the aggregate — identical history
+        # would mean the fault silently didn't apply.
+        history_ok = (prefix_ok and sorted(hist) == sorted(baseline)
+                      and hist.get(k) != baseline.get(k))
+    else:
+        history_ok = full_ok
+    row = {
+        "scenario": name,
+        "rc": out.returncode,
+        "survived": out.returncode == 0 and sorted(hist) == sorted(baseline),
+        "history_match": history_ok,
+        "faults": len(res.get("faults") or []),
+        "restarts": res.get("restarts") or 0,
+        "rollbacks": len(res.get("rollbacks") or []),
+    }
+    row["ok"] = (row["survived"] and row["history_match"]
+                 and row["faults"] >= 1
+                 and (row["restarts"] >= 1
+                      if name in ("sigkill", "preempt") else True)
+                 and (row["rollbacks"] >= 1
+                      if name == "nan_rollback" else True))
+    if not row["ok"]:
+        row["stderr_tail"] = (out.stderr or "")[-2000:]
+    return row
+
+
+def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
+              num_clients: int = 4, workdir: Optional[str] = None,
+              keep_artifacts: bool = False, timeout: int = 600,
+              platform: str = "cpu", verbose: bool = True) -> dict:
+    """Execute the matrix; returns the report dict (``ok`` = all rows
+    ok). Artifacts live under ``workdir`` (a fresh temp dir by default,
+    removed afterwards unless ``keep_artifacts``)."""
+    names = tuple(scenarios) if scenarios else SCENARIOS
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        raise ValueError(f"unknown chaos scenario(s) {unknown}; "
+                         f"pick from {list(SCENARIOS)}")
+    if rounds < 4:
+        raise ValueError("chaos needs --rounds >= 4: a checkpoint must "
+                         "precede the mid-run fault round")
+    own_dir = workdir is None
+    wd = workdir or tempfile.mkdtemp(prefix="fedtpu-chaos-")
+    os.makedirs(wd, exist_ok=True)
+    try:
+        if verbose:
+            print(f"[chaos] baseline run ({rounds} rounds, "
+                  f"{num_clients} clients) in {wd}")
+        base = subprocess.run(
+            [sys.executable, "-m", "fedtpu.cli",
+             *_run_args(wd, "baseline", rounds, num_clients, platform)],
+            env=_child_env(), capture_output=True, text=True,
+            timeout=timeout)
+        if base.returncode != 0:
+            return {"ok": False, "error": "baseline run failed",
+                    "rc": base.returncode,
+                    "stderr_tail": (base.stderr or "")[-2000:],
+                    "scenarios": [], "workdir": wd}
+        baseline = _history(os.path.join(wd, "baseline.metrics.jsonl"))
+
+        rows = []
+        for name in names:
+            if verbose:
+                print(f"[chaos] scenario {name} ...", flush=True)
+            row = run_scenario(name, wd, baseline, rounds, num_clients,
+                               platform, timeout)
+            rows.append(row)
+            if verbose:
+                status = "ok" if row["ok"] else "FAIL"
+                print(f"[chaos]   {name}: {status} rc={row['rc']} "
+                      f"survived={row['survived']} "
+                      f"history_match={row['history_match']} "
+                      f"faults={row['faults']} restarts={row['restarts']} "
+                      f"rollbacks={row['rollbacks']}")
+        report = {"ok": all(r["ok"] for r in rows), "rounds": rounds,
+                  "num_clients": num_clients, "scenarios": rows,
+                  "workdir": wd if keep_artifacts else None}
+        return report
+    finally:
+        if own_dir and not keep_artifacts:
+            shutil.rmtree(wd, ignore_errors=True)

@@ -1,0 +1,361 @@
+"""Deterministic fault injection: the FaultPlan and its in-loop injector.
+
+A FaultPlan is a seeded, JSON-driven schedule of failures the round loop
+applies to ITSELF — the point is reproducibility: the same plan against
+the same config produces the same fault at the same round on every run,
+so recovery behavior (supervisor restart, divergence rollback) is
+testable as an exact-equality property instead of a flaky observation.
+
+Plan schema (path or inline JSON via ``RunConfig.fault_plan`` /
+``fedtpu run --fault-plan``)::
+
+    {"seed": 0,
+     "faults": [
+       {"kind": "client_dropout", "round": 3, "clients": [1]},
+       {"kind": "straggler",      "round": 2, "clients": [0], "delay_s": 0.05},
+       {"kind": "nan_update",     "round": 4, "clients": [2]},
+       {"kind": "process_kill",   "round": 5, "signal": "SIGKILL",
+        "process_index": 0},
+       {"kind": "ckpt_corrupt",   "round": 6}]}
+
+``round`` is 1-based (round 1 is the first trained round). Instead of a
+fixed ``round`` an entry may carry ``"probability": p`` with an optional
+``"rounds": [lo, hi]`` window — materialized ONCE at load time from the
+plan seed (``np.random.RandomState``), so the "random" schedule is still
+a pure function of the plan.
+
+Fault semantics (see docs/resilience.md for the full taxonomy):
+
+* ``client_dropout`` — zero the named clients' sample-mask rows for that
+  one round. Under ``weighting='data_size'`` the in-graph weights are
+  ``mask.sum(axis=1)``, so a dropped client's aggregation weight is
+  EXACTLY zero and ``masked_client_mean`` excludes it from the
+  client-mean metrics. ``"sticky": true`` keeps the client out for the
+  rest of the run.
+* ``straggler`` — host-side ``time.sleep(delay_s)`` before dispatching
+  the round: the lockstep round is gated by its slowest client, so only
+  timing changes — the metric history stays bitwise identical.
+* ``nan_update`` — poison the named clients' parameter slots with NaN
+  before the round; the aggregated global goes NaN and the loop's
+  divergence guard fires (halt or rollback per ``--on-divergence``).
+* ``process_kill`` — ``os.kill(self, signal)`` when this process's index
+  matches: SIGKILL dies mid-round (crash path), SIGTERM exercises the
+  graceful drain (checkpoint + exit 75, see fedtpu.resilience.supervisor).
+* ``ckpt_corrupt`` — truncate + overwrite the latest complete
+  checkpoint's state payload on disk: invisible to the commit check,
+  caught only by the restore fallback (checkpoint.load_checkpoint_fallback).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import signal as _signal
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+KINDS = ("client_dropout", "straggler", "nan_update", "process_kill",
+         "ckpt_corrupt")
+
+# Faults that must fire at most once per RUN even across supervisor
+# restarts: a restarted run resumes BELOW the fault round, so re-arming a
+# kill would loop forever (kill -> restart -> replay -> kill ...). Armed
+# only on the first launch (FEDTPU_RESTARTS == 0 / restart_count == 0).
+ONCE_KINDS = ("process_kill", "ckpt_corrupt")
+
+_SIGNALS = ("SIGKILL", "SIGTERM", "SIGINT")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One materialized fault occurrence."""
+
+    kind: str
+    round: int                        # 1-based round the fault strikes
+    clients: Tuple[int, ...] = ()
+    delay_s: float = 0.0              # straggler only
+    signal: str = "SIGKILL"           # process_kill only
+    process_index: int = 0            # process_kill only
+    sticky: bool = False              # client_dropout only
+
+    def payload(self) -> dict:
+        """Tracer-event payload (only the fields this kind uses). The
+        fault kind is keyed ``fault`` — ``kind`` is the event kind slot
+        in the tracer schema."""
+        out = {"fault": self.kind, "fault_round": self.round}
+        if self.clients:
+            out["clients"] = list(self.clients)
+        if self.kind == "straggler":
+            out["delay_s"] = self.delay_s
+        if self.kind == "process_kill":
+            out["signal"] = self.signal
+            out["process_index"] = self.process_index
+        if self.sticky:
+            out["sticky"] = True
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Materialized, validated fault schedule + its content digest."""
+
+    seed: int
+    faults: Tuple[Fault, ...]
+    digest: str                       # sha256[:16] of the canonical dump
+
+    @classmethod
+    def load(cls, spec, num_clients: int, rounds: int) -> "FaultPlan":
+        """Parse + materialize + validate a plan. ``spec`` is a JSON file
+        path, an inline JSON string (first non-space char ``{``), or an
+        already-parsed dict. Probabilistic entries are expanded here, so
+        the returned plan — and its digest — is the exact schedule the
+        run will execute."""
+        if isinstance(spec, str):
+            if spec.lstrip().startswith("{"):
+                raw = json.loads(spec)
+            else:
+                with open(spec) as fh:
+                    raw = json.load(fh)
+        else:
+            raw = dict(spec)
+        if not isinstance(raw, dict):
+            raise ValueError("fault plan must be a JSON object with a "
+                             "'faults' list")
+        seed = int(raw.get("seed", 0))
+        rng = np.random.RandomState(seed)
+        faults = []
+        for i, entry in enumerate(raw.get("faults", ())):
+            kind = entry.get("kind")
+            if kind not in KINDS:
+                raise ValueError(f"fault #{i}: unknown kind {kind!r} "
+                                 f"(one of {KINDS})")
+            if "probability" in entry:
+                p = float(entry["probability"])
+                if not 0.0 <= p <= 1.0:
+                    raise ValueError(f"fault #{i}: probability {p} outside "
+                                     "[0, 1]")
+                lo, hi = entry.get("rounds", (1, rounds))
+                lo, hi = int(lo), int(hi)
+                # One draw per round in the window, in round order — a
+                # pure function of (plan seed, entry order).
+                hits = [lo + j for j, u
+                        in enumerate(rng.random_sample(max(0, hi - lo + 1)))
+                        if u < p]
+            else:
+                if "round" not in entry:
+                    raise ValueError(f"fault #{i}: needs 'round' or "
+                                     "'probability'")
+                hits = [int(entry["round"])]
+            clients = tuple(int(c) for c in entry.get("clients", ()))
+            for c in clients:
+                if not 0 <= c < num_clients:
+                    raise ValueError(f"fault #{i}: client {c} outside "
+                                     f"[0, {num_clients})")
+            if kind in ("client_dropout", "nan_update") and not clients:
+                raise ValueError(f"fault #{i}: {kind} needs 'clients'")
+            sig = str(entry.get("signal", "SIGKILL"))
+            if kind == "process_kill" and sig not in _SIGNALS:
+                raise ValueError(f"fault #{i}: signal {sig!r} not one of "
+                                 f"{_SIGNALS}")
+            delay = float(entry.get("delay_s", 0.0))
+            if kind == "straggler" and delay <= 0:
+                raise ValueError(f"fault #{i}: straggler needs delay_s > 0")
+            for k in hits:
+                if not 1 <= k <= rounds:
+                    raise ValueError(f"fault #{i}: round {k} outside "
+                                     f"[1, {rounds}]")
+                faults.append(Fault(
+                    kind=kind, round=k, clients=clients, delay_s=delay,
+                    signal=sig,
+                    process_index=int(entry.get("process_index", 0)),
+                    sticky=bool(entry.get("sticky", False))))
+        faults.sort(key=lambda f: f.round)
+        canon = json.dumps(
+            {"seed": seed,
+             "faults": [dataclasses.asdict(f) for f in faults]},
+            sort_keys=True)
+        return cls(seed=seed, faults=tuple(faults),
+                   digest=hashlib.sha256(canon.encode()).hexdigest()[:16])
+
+
+# Module-level jits (never constructed in the loop — FTP006): the mask /
+# slot edits a fault applies are ordinary jax ops, so they work unchanged
+# on sharded arrays under the mesh.
+@jax.jit
+def _zero_rows(mask, rows):
+    return mask.at[rows].set(0.0)
+
+
+@jax.jit
+def _nan_rows(leaf, rows):
+    return leaf.at[rows].set(jnp.nan)
+
+
+@jax.jit
+def _perturb_tree(tree, key, scale):
+    """``leaf * (1 + scale * U[-1, 1])`` on every floating leaf — the
+    deterministic relative perturbation a second rollback retry applies
+    to break out of a divergence that replays identically."""
+    leaves, treedef = jax.tree.flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    out = []
+    for leaf, k in zip(leaves, keys):
+        if jnp.issubdtype(leaf.dtype, jnp.inexact):
+            u = jax.random.uniform(k, leaf.shape, leaf.dtype)
+            leaf = leaf * (1.0 + scale * (2.0 * u - 1.0))
+        out.append(leaf)
+    return jax.tree.unflatten(treedef, out)
+
+
+def drop_clients(mask, clients: Sequence[int]):
+    """Zero the named clients' sample-mask rows: exact weight-0 exclusion
+    under data-size weighting (the in-graph weights are mask.sum(axis=1))
+    and exclusion from the client-mean metrics (masked_client_mean skips
+    empty clients). Shared by the dropout fault and rollback exclusion."""
+    return _zero_rows(mask, jnp.asarray(tuple(clients), jnp.int32))
+
+
+def poison_client_slots(params, clients: Sequence[int]):
+    """NaN the named client slots of every floating params leaf."""
+    rows = jnp.asarray(tuple(clients), jnp.int32)
+    return jax.tree.map(
+        lambda l: _nan_rows(l, rows)
+        if jnp.issubdtype(l.dtype, jnp.inexact) else l, params)
+
+
+def perturb_params(params, attempt: int, scale: float):
+    """Rollback retry #``attempt``'s perturbed restart point: a pure
+    function of (restored params, attempt, scale), so every process — and
+    every re-run — perturbs identically."""
+    return _perturb_tree(params, jax.random.key(attempt), scale)
+
+
+def corrupt_checkpoint(directory: str, step: Optional[int] = None
+                       ) -> Optional[int]:
+    """In-place corruption of the latest complete checkpoint's state
+    payload: truncate the largest file to half and stomp its header. The
+    round still looks committed (state/ and meta/ both exist) — exactly
+    the failure mode a dying disk produces — so only a restore attempt
+    (and the fallback walk in load_checkpoint_fallback) discovers it.
+    Returns the corrupted step, or None when there is nothing to corrupt.
+    """
+    from fedtpu.orchestration.checkpoint import latest_step
+    if step is None:
+        step = latest_step(directory)
+    if step is None:
+        return None
+    state_dir = os.path.join(os.path.abspath(directory),
+                             f"round_{step:06d}", "state")
+    target, size = None, -1
+    for root, _, names in os.walk(state_dir):
+        for name in names:
+            p = os.path.join(root, name)
+            s = os.path.getsize(p)
+            if s > size:
+                target, size = p, s
+    if target is None:
+        return None
+    with open(target, "r+b") as fh:
+        fh.truncate(max(1, size // 2))
+        fh.seek(0)
+        fh.write(b"\xde\xad\xbe\xef" * 16)
+    return step
+
+
+class FaultInjector:
+    """Applies a FaultPlan inside the round loop.
+
+    The loop calls ``chunk_limit`` (shrink a multi-round chunk so a fault
+    round runs as its own width-1 dispatch), ``pre_round`` (apply every
+    fault scheduled for the next round), and ``post_round`` (undo
+    non-sticky per-round faults, i.e. restore the dropout mask).
+
+    ``restart_count > 0`` (a supervisor restart, ``FEDTPU_RESTARTS``)
+    disarms the once-per-run kinds (``process_kill``, ``ckpt_corrupt``)
+    so a resumed run replays the fault window cleanly instead of
+    re-killing itself forever.
+    """
+
+    def __init__(self, plan: FaultPlan, restart_count: int = 0,
+                 tracer=None, registry=None, process_index: int = 0):
+        self.plan = plan
+        self._armed = [f for f in plan.faults
+                       if not (f.kind in ONCE_KINDS and restart_count > 0)]
+        self._tracer = tracer
+        self._registry = registry
+        self._proc = process_index
+        self._saved_mask = None
+
+    @property
+    def armed_count(self) -> int:
+        return len(self._armed)
+
+    def chunk_limit(self, rnd: int, take: int) -> int:
+        """Largest chunk width starting at 0-based round ``rnd`` that
+        keeps every fault round in a width-1 dispatch (a fault at 1-based
+        round k applies before dispatching round index k-1, and its
+        post-round restore needs that round to end the chunk)."""
+        nxt = min((f.round - 1 for f in self._armed if f.round - 1 >= rnd),
+                  default=None)
+        if nxt is None or nxt >= rnd + take:
+            return take
+        return 1 if nxt == rnd else nxt - rnd
+
+    def _event(self, f: Fault) -> None:
+        if self._tracer is not None:
+            self._tracer.event("fault", round=f.round, **f.payload())
+        if self._registry is not None:
+            self._registry.counter("faults_injected").inc()
+            self._registry.counter(f"faults_{f.kind}").inc()
+
+    def pre_round(self, rnd: int, state: dict, batch: dict,
+                  checkpoint_dir: Optional[str] = None) -> None:
+        """Apply every armed fault scheduled for 0-based round ``rnd``
+        (mutating ``state``/``batch`` entries in place)."""
+        due = [f for f in self._armed if f.round - 1 == rnd]
+        if not due:
+            return
+        self._armed = [f for f in self._armed if f.round - 1 != rnd]
+        for f in due:
+            # Event BEFORE applying: SIGKILL never returns, and the sink
+            # flushes per event — the fault must be attributable post-mortem.
+            self._event(f)
+            if f.kind == "client_dropout":
+                if self._saved_mask is None and not f.sticky:
+                    self._saved_mask = batch["mask"]
+                batch["mask"] = _zero_rows(
+                    batch["mask"], jnp.asarray(f.clients, jnp.int32))
+            elif f.kind == "straggler":
+                time.sleep(f.delay_s)
+            elif f.kind == "nan_update":
+                state["params"] = poison_client_slots(state["params"],
+                                                      f.clients)
+            elif f.kind == "process_kill":
+                if self._proc == f.process_index:
+                    os.kill(os.getpid(), getattr(_signal, f.signal))
+            elif f.kind == "ckpt_corrupt":
+                if checkpoint_dir and self._proc == 0:
+                    corrupt_checkpoint(checkpoint_dir)
+
+    def post_round(self, rnd: int, batch: dict) -> None:
+        """Undo non-sticky per-round faults after the dispatch that
+        consumed them: rebinding the ORIGINAL mask array makes every
+        subsequent round bitwise-identical to an unfaulted run."""
+        if self._saved_mask is not None:
+            batch["mask"] = self._saved_mask
+            self._saved_mask = None
+
+    def exclude(self, clients: Sequence[int]) -> None:
+        """Rollback excluded these clients from the federation — drop
+        their still-armed faults (a departed client cannot re-inject),
+        which is what makes exclusion converge for sticky-divergence
+        sources."""
+        cs = set(clients)
+        self._armed = [f for f in self._armed
+                       if not (f.clients and set(f.clients) <= cs)]

@@ -1,0 +1,204 @@
+"""Supervised execution: exit-code contract, heartbeat, auto-restart.
+
+The contract between the round loop, the CLI, and the supervisor:
+
+* ``EXIT_OK`` (0) — run completed (or stopped early); nothing to do.
+* ``EXIT_DIVERGED`` (3) — the divergence policy halted the run (NaN
+  state quarantined under ``<checkpoint_dir>/diverged``). A restart
+  would deterministically re-diverge, so the supervisor does NOT
+  restart this code.
+* ``EXIT_PREEMPTED`` (75, BSD EX_TEMPFAIL) — the loop caught SIGTERM,
+  drained to a checkpoint, and exited cleanly; the supervisor restarts
+  immediately with ``--resume`` (no backoff — the exit was graceful).
+* anything else — a crash (SIGKILL shows up as a negative returncode);
+  the supervisor restarts with ``--resume`` under bounded exponential
+  backoff.
+
+Liveness: the loop writes a heartbeat file (``--heartbeat``, atomic
+tmp+rename) at start and every chunk; ``--hang-timeout`` turns a stale
+heartbeat into SIGKILL + crash-restart, which is the only way out of a
+wedged collective.
+
+Restart identity: the restarted child gets ``FEDTPU_RESTARTS=<n>`` (the
+fault injector disarms once-per-run kill faults when > 0, see
+fedtpu.resilience.faults) and ``FEDTPU_SUPERVISED=1``. Because resume
+restores bit-identical state and the round program is deterministic, a
+supervised run that crashed mid-round finishes with exactly the metric
+history of an uninterrupted run — the property tests/test_chaos_supervised.py
+asserts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+EXIT_OK = 0
+EXIT_DIVERGED = 3
+EXIT_PREEMPTED = 75          # EX_TEMPFAIL: drained to checkpoint, resumable
+
+
+class Preempted(Exception):
+    """Raised by the round loop after a SIGTERM drain: the state is
+    checkpointed; the process should exit ``EXIT_PREEMPTED`` so the
+    supervisor restarts it with ``--resume``."""
+
+    def __init__(self, round_: int):
+        super().__init__(f"preempted at round {round_} (checkpoint drained)")
+        self.round = round_
+
+
+def write_heartbeat(path: str, **payload) -> None:
+    """Atomic heartbeat write (tmp + rename): the supervisor's liveness
+    probe must never see a half-written file."""
+    payload.setdefault("pid", os.getpid())
+    payload["time"] = time.time()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)
+
+
+def read_heartbeat(path: str) -> Optional[dict]:
+    """Last heartbeat payload, or None (missing/mid-crash garbage)."""
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _drain_child(child: subprocess.Popen, grace: float) -> int:
+    """Graceful handoff: SIGTERM, wait ``grace`` for the checkpoint
+    drain, then SIGKILL. Returns the child's returncode."""
+    child.terminate()
+    try:
+        return child.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        return child.wait()
+
+
+def _wait(child: subprocess.Popen, signaled: dict, heartbeat: Optional[str],
+          hang_timeout: Optional[float], grace: float,
+          started: float) -> Tuple[int, bool]:
+    """Poll the child to completion. Returns (returncode, hung). Forwards
+    an external stop signal as a graceful drain; a heartbeat stale past
+    ``hang_timeout`` is killed and reported as hung."""
+    while True:
+        try:
+            return child.wait(timeout=0.2), False
+        except subprocess.TimeoutExpired:
+            pass
+        if signaled["sig"] is not None:
+            return _drain_child(child, grace), False
+        if hang_timeout and heartbeat:
+            try:
+                last = os.path.getmtime(heartbeat)
+            except OSError:
+                last = started          # not written yet: age from launch
+            if time.time() - max(last, started) > hang_timeout:
+                child.kill()
+                return child.wait(), True
+
+
+def supervise(child_argv: Sequence[str], max_restarts: int = 2,
+              backoff_base: float = 1.0, backoff_max: float = 30.0,
+              grace: float = 15.0, hang_timeout: Optional[float] = None,
+              heartbeat: Optional[str] = None, events: Optional[str] = None,
+              extra_env: Optional[dict] = None,
+              _cmd_prefix: Optional[List[str]] = None,
+              verbose: bool = True) -> int:
+    """Run ``fedtpu <child_argv>`` as a child process and keep it alive
+    per the exit-code contract above. Returns the final exit code (the
+    child's last code when the budget is exhausted).
+
+    ``heartbeat`` is passed to ``run`` children as ``--heartbeat`` and
+    monitored when ``hang_timeout`` is set. ``events`` appends supervisor
+    events (child_start/child_exit/restart/supervisor_exit) to the same
+    JSONL sink the child's tracer appends to — one merged timeline.
+    ``_cmd_prefix`` (tests) replaces the default
+    ``python -m fedtpu.cli`` child command.
+    """
+    from fedtpu.telemetry import make_tracer
+    tracer = make_tracer(events)
+    prefix = (list(_cmd_prefix) if _cmd_prefix is not None
+              else [sys.executable, "-m", "fedtpu.cli"])
+    base = list(child_argv)
+    is_run = bool(base) and base[0] == "run"
+    if heartbeat and is_run and "--heartbeat" not in base:
+        base += ["--heartbeat", heartbeat]
+
+    # Forwarded stop: SIGTERM/SIGINT to the supervisor drains the child
+    # and returns ITS code — an external preemption of the whole tree
+    # must not be answered with a restart. Signal handlers only exist on
+    # the main thread; elsewhere (tests driving supervise from a worker)
+    # external stop simply isn't intercepted.
+    signaled = {"sig": None}
+    restore: List[Tuple[int, object]] = []
+    if threading.current_thread() is threading.main_thread():
+        def _on_sig(signum, frame):
+            signaled["sig"] = signum
+        for s in (signal.SIGTERM, signal.SIGINT):
+            restore.append((s, signal.signal(s, _on_sig)))
+
+    restarts = 0
+    tracer.event("supervisor_start", max_restarts=max_restarts,
+                 cmd=prefix + base)
+    try:
+        while True:
+            argv = list(base)
+            if restarts > 0 and is_run and "--resume" not in argv:
+                argv.append("--resume")
+            env = dict(os.environ, FEDTPU_RESTARTS=str(restarts),
+                       FEDTPU_SUPERVISED="1")
+            if extra_env:
+                env.update(extra_env)
+            started = time.time()
+            child = subprocess.Popen(prefix + argv, env=env)
+            tracer.event("child_start", pid=child.pid, restarts=restarts)
+            rc, hung = _wait(child, signaled, heartbeat, hang_timeout,
+                             grace, started)
+            tracer.event("child_exit", rc=rc, restarts=restarts, hung=hung,
+                         dur_s=time.time() - started)
+            if signaled["sig"] is not None:
+                tracer.event("supervisor_exit", rc=rc, reason="signaled",
+                             restarts=restarts)
+                return rc
+            if rc in (EXIT_OK, EXIT_DIVERGED):
+                # 3 is a POLICY halt: restarting would deterministically
+                # re-diverge (same state, same data, same rounds).
+                tracer.event("supervisor_exit", rc=rc,
+                             reason="done" if rc == EXIT_OK else "diverged",
+                             restarts=restarts)
+                return rc
+            if restarts >= max_restarts:
+                tracer.event("supervisor_exit", rc=rc,
+                             reason="budget_exhausted", restarts=restarts)
+                if verbose:
+                    print(f"[supervise] rc={rc} with restart budget "
+                          f"exhausted ({max_restarts}); giving up")
+                return rc
+            delay = (0.0 if rc == EXIT_PREEMPTED
+                     else min(backoff_max, backoff_base * (2 ** restarts)))
+            restarts += 1
+            tracer.event("restart", restarts=restarts, rc=rc, hung=hung,
+                         backoff_s=delay, resume=is_run)
+            if verbose:
+                why = "hung" if hung else (
+                    "preempted" if rc == EXIT_PREEMPTED else f"rc={rc}")
+                print(f"[supervise] child {why}; restart "
+                      f"{restarts}/{max_restarts}"
+                      + (f" after {delay:.1f}s backoff" if delay else ""))
+            if delay:
+                time.sleep(delay)
+    finally:
+        for s, h in restore:
+            signal.signal(s, h)
+        tracer.close()

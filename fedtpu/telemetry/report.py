@@ -64,6 +64,15 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     last_counters = None
     run_ids = []
     newer_schema = 0
+    faults: List[dict] = []
+    rollbacks: List[dict] = []
+    exclusions: List[dict] = []
+    restarts: List[dict] = []
+    child_exits: List[dict] = []
+    preempted_rounds: List[int] = []
+    resume_rounds: List[int] = []
+    diverged_at: Optional[dict] = None
+    supervisor_exit: Optional[dict] = None
     for e in events:
         v = e.get("v")
         if isinstance(v, int) and v > EVENT_SCHEMA_VERSION:
@@ -90,6 +99,27 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             manifest = payload
         elif kind == "counters":
             last_counters = payload
+        # Resilience timeline (fedtpu.resilience; docs/resilience.md).
+        # Supervisor events and in-run fault/rollback events usually share
+        # one sink, so the report sees the whole incident end to end.
+        elif kind == "fault":
+            faults.append({"round": e.get("round"), **payload})
+        elif kind == "rollback":
+            rollbacks.append({"round": e.get("round"), **payload})
+        elif kind == "exclusion":
+            exclusions.append({"round": e.get("round"), **payload})
+        elif kind == "restart":
+            restarts.append(payload)
+        elif kind == "child_exit":
+            child_exits.append(payload)
+        elif kind == "preempted":
+            preempted_rounds.append(int(e.get("round") or 0))
+        elif kind == "resume":
+            resume_rounds.append(int(e.get("round") or 0))
+        elif kind == "diverged":
+            diverged_at = {"round": e.get("round"), **payload}
+        elif kind == "supervisor_exit":
+            supervisor_exit = payload
 
     out: dict = {
         "events_total": len(events),
@@ -102,14 +132,29 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         "rounds": {"count": len(round_durs), "last_round": round_max},
         "staleness": None,
         "counters": {}, "gauges": {}, "histograms": {},
+        "resilience": None,
     }
     if manifest:
         out["manifest"] = {k: manifest.get(k) for k in
                            ("config_hash", "package_version", "jax_version",
                             "backend", "device_count", "device_kinds",
                             "mesh_shape", "git_rev", "process_count",
-                            "program", "engine")
+                            "program", "engine", "restarts", "fault_plan")
                            if manifest.get(k) is not None}
+    if (faults or rollbacks or exclusions or restarts or child_exits
+            or preempted_rounds or resume_rounds or diverged_at
+            or supervisor_exit):
+        out["resilience"] = {
+            "faults": faults,
+            "rollbacks": rollbacks,
+            "exclusions": exclusions,
+            "restarts": len(restarts),
+            "child_exit_codes": [c.get("rc") for c in child_exits],
+            "preempted_rounds": preempted_rounds,
+            "resume_rounds": resume_rounds,
+            "diverged": diverged_at,
+            "supervisor_exit": supervisor_exit,
+        }
     if round_durs:
         out["rounds"]["total_s"] = float(np.sum(round_durs))
         out["rounds"]["cadence"] = _percentiles(round_durs)
@@ -175,6 +220,39 @@ def render_text(agg: dict) -> str:
         elif st.get("round_mean_of_means") is not None:
             lines.append(f"staleness: mean-of-round-means "
                          f"{st['round_mean_of_means']:.3f}")
+    res = agg.get("resilience")
+    if res:
+        lines.append("resilience:")
+        for f in res.get("faults") or []:
+            detail = ", ".join(f"{k}={f[k]}" for k in sorted(f)
+                               if k not in ("fault", "fault_round", "round"))
+            lines.append(f"  fault {f.get('fault')} @ round {f.get('round')}"
+                         + (f" ({detail})" if detail else ""))
+        for rb in res.get("rollbacks") or []:
+            lines.append(f"  rollback @ round {rb.get('round')} -> "
+                         f"restored round {rb.get('restored_round')} "
+                         f"(attempt {rb.get('attempt')}, "
+                         f"reason: {rb.get('reason')})")
+        for ex in res.get("exclusions") or []:
+            lines.append(f"  excluded clients {ex.get('clients')} "
+                         f"@ round {ex.get('round')}")
+        if res.get("restarts"):
+            lines.append(f"  supervisor restarts: {res['restarts']} "
+                         f"(child exit codes: "
+                         f"{res.get('child_exit_codes')})")
+        if res.get("preempted_rounds"):
+            lines.append("  preempted (graceful drain) at rounds: "
+                         f"{res['preempted_rounds']}")
+        if res.get("resume_rounds"):
+            lines.append(f"  resumed at rounds: {res['resume_rounds']}")
+        if res.get("diverged"):
+            d = res["diverged"]
+            lines.append(f"  DIVERGED @ round {d.get('round')}: "
+                         f"{d.get('reason')}")
+        if res.get("supervisor_exit"):
+            se = res["supervisor_exit"]
+            lines.append(f"  supervisor exit: rc={se.get('rc')} "
+                         f"reason={se.get('reason')}")
     if agg.get("counters"):
         lines.append("counters:")
         for k, v in sorted(agg["counters"].items()):

@@ -174,6 +174,14 @@ QUICK_TESTS = {
     # only; fedtpu/parallel/multihost.py is covered above in-process.
     # test_chaos_resume SIGKILLs subprocess CLI runs (~60 s) and stays
     # full-tier only; the resume machinery is covered by test_checkpoint.
+    # round-6 modules
+    # resilience subsystem (fault plans, rollback, supervisor contract —
+    # both picks are backend-free and run in milliseconds)
+    "test_resilience.py::test_plan_spec_forms_are_identical",
+    "test_resilience.py::test_chunk_limit_isolates_fault_rounds",
+    # test_chaos_supervised runs supervised subprocess CLI children
+    # (kill + restart, ~90 s) and stays full-tier only; the in-process
+    # resilience semantics are covered by test_resilience above.
 }
 
 
@@ -211,7 +219,8 @@ def pytest_collection_modifyitems(config, items):
                 f"conftest QUICK_TESTS entries match nothing (renamed or "
                 f"removed tests?): {sorted(stale)}")
     uncovered = (modules_all - modules_quick
-                 - {"test_multihost_e2e.py", "test_chaos_resume.py"}
+                 - {"test_multihost_e2e.py", "test_chaos_resume.py",
+                    "test_chaos_supervised.py"}
                  if quick_modules_expected <= modules_all else set())
     if uncovered:
         raise pytest.UsageError(

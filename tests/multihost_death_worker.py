@@ -63,7 +63,7 @@ def main():
 
     if pid == 1:
         print(f"worker {pid}: dying abruptly now", flush=True)  # fedtpu: noqa[FTP005] stdout IS the worker->parent IPC protocol
-        os._exit(77)
+        os._exit(77)  # fedtpu: noqa[FTP007] simulating an abrupt worker death is this script's whole job
 
     # Survivor: keep stepping AND fetching. The fetch is the part that can
     # hang — it must instead end in the runtime terminating this process.
@@ -78,7 +78,7 @@ def main():
     # Unreachable if propagation works: the runtime must have killed us.
     with open(os.path.join(outdir, "survivor_never_died.txt"), "w") as f:
         f.write(f"{time.time() - t0:.1f}")
-    sys.exit(3)
+    sys.exit(3)  # fedtpu: noqa[FTP007] worker script exit code is the parent test's assertion signal
 
 
 if __name__ == "__main__":

@@ -186,6 +186,25 @@ FIXTURES = {
                 print("hi")  # fedtpu: noqa[FTP005] fixture
             """,
     },
+    "FTP007": {
+        "positive": """
+            import sys
+            def worker(rc):
+                sys.exit(rc)
+            """,
+        "negative": """
+            import sys
+            def worker(rc):
+                raise RuntimeError(f"worker failed rc={rc}")
+            def parse(argv):
+                return sys.argv[1:]          # sys use, not an exit
+            """,
+        "suppressed": """
+            import os
+            def die():
+                os._exit(7)  # fedtpu: noqa[FTP007] fixture
+            """,
+    },
     "FTP101": {
         "positive": """
             def f(xs=[]):

@@ -1,0 +1,360 @@
+"""Resilience subsystem (fedtpu.resilience): FaultPlan determinism and
+validation, in-loop injection semantics, divergence rollback (recovery,
+budget, exclusion), SIGTERM drain -> Preempted, heartbeat, the
+supervisor's exit-code contract (scripted children), and the report's
+resilience section. Process-killing end-to-end variants live in
+tests/test_chaos_supervised.py."""
+
+import dataclasses
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                           OptimConfig, RunConfig, ShardConfig)
+from fedtpu.orchestration.loop import run_experiment
+from fedtpu.resilience.faults import (FaultInjector, FaultPlan,
+                                      corrupt_checkpoint)
+from fedtpu.resilience.supervisor import (EXIT_PREEMPTED, Preempted,
+                                          read_heartbeat, supervise,
+                                          write_heartbeat)
+
+ROUNDS = 6
+NAN_PLAN = json.dumps(
+    {"seed": 0, "faults": [{"kind": "nan_update", "round": 3,
+                            "clients": [1]}]})
+
+
+def _cfg(rounds=ROUNDS, **run_kw):
+    return ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=128),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=rounds),
+        run=RunConfig(**run_kw),
+    )
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """One uninterrupted reference run shared by the exact-recovery
+    assertions below."""
+    return run_experiment(_cfg(), verbose=False)
+
+
+# ------------------------------------------------------------- FaultPlan
+def test_plan_spec_forms_are_identical(tmp_path):
+    raw = {"seed": 3, "faults": [
+        {"kind": "client_dropout", "round": 2, "clients": [1, 3]},
+        {"kind": "straggler", "round": 1, "clients": [0], "delay_s": 0.5}]}
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(raw))
+    from_dict = FaultPlan.load(raw, num_clients=8, rounds=10)
+    from_inline = FaultPlan.load(json.dumps(raw), num_clients=8, rounds=10)
+    from_file = FaultPlan.load(str(p), num_clients=8, rounds=10)
+    assert from_dict == from_inline == from_file
+    assert len(from_dict.digest) == 16
+    # Materialized plans come back sorted by round.
+    assert [f.round for f in from_dict.faults] == [1, 2]
+
+
+def test_plan_probabilistic_is_a_pure_function_of_the_seed():
+    spec = {"seed": 7, "faults": [
+        {"kind": "straggler", "probability": 0.3, "rounds": [1, 50],
+         "clients": [2], "delay_s": 0.01}]}
+    a = FaultPlan.load(spec, num_clients=8, rounds=50)
+    b = FaultPlan.load(spec, num_clients=8, rounds=50)
+    assert a == b and a.faults           # same seed: identical schedule
+    c = FaultPlan.load({**spec, "seed": 8}, num_clients=8, rounds=50)
+    assert c.digest != a.digest          # seed is part of the schedule
+
+
+@pytest.mark.parametrize("entry,match", [
+    ({"kind": "meteor", "round": 1}, "unknown kind"),
+    ({"kind": "straggler", "clients": [0]}, "'round' or 'probability'"),
+    ({"kind": "nan_update", "round": 1, "clients": [99]}, "outside"),
+    ({"kind": "client_dropout", "round": 1}, "needs 'clients'"),
+    ({"kind": "process_kill", "round": 1, "signal": "SIGSTOP"}, "signal"),
+    ({"kind": "straggler", "round": 1, "clients": [0]}, "delay_s"),
+    ({"kind": "nan_update", "round": 99, "clients": [0]}, "outside"),
+    ({"kind": "nan_update", "probability": 1.5, "clients": [0]},
+     "probability"),
+])
+def test_plan_validation_rejects(entry, match):
+    with pytest.raises(ValueError, match=match):
+        FaultPlan.load({"faults": [entry]}, num_clients=8, rounds=10)
+
+
+# ---------------------------------------------------------- FaultInjector
+def _injector(spec, restart_count=0):
+    plan = FaultPlan.load(spec, num_clients=8, rounds=20)
+    return FaultInjector(plan, restart_count=restart_count)
+
+
+def test_chunk_limit_isolates_fault_rounds():
+    inj = _injector({"faults": [{"kind": "straggler", "round": 4,
+                                 "clients": [0], "delay_s": 0.001}]})
+    # 0-based round 3 carries the fault: a chunk from round 0 must stop
+    # short of it, a chunk AT it must be width 1, past it is unlimited.
+    assert inj.chunk_limit(0, 8) == 3
+    assert inj.chunk_limit(3, 8) == 1
+    assert inj.chunk_limit(4, 8) == 8
+    inj.pre_round(3, {}, {})             # consumes the fault (sleeps 1ms)
+    assert inj.chunk_limit(0, 8) == 8    # nothing armed anymore
+
+
+def test_once_kinds_disarm_on_restart():
+    spec = {"faults": [
+        {"kind": "process_kill", "round": 5},
+        {"kind": "ckpt_corrupt", "round": 6},
+        {"kind": "client_dropout", "round": 7, "clients": [2]}]}
+    assert _injector(spec, restart_count=0).armed_count == 3
+    # A supervised restart replays the kill window: only the dropout
+    # survives (re-arming the kill would loop kill->restart forever).
+    assert _injector(spec, restart_count=1).armed_count == 1
+
+
+def test_dropout_zeroes_then_restores_the_original_mask():
+    inj = _injector({"faults": [{"kind": "client_dropout", "round": 1,
+                                 "clients": [2, 5]}]})
+    mask = jnp.ones((8, 16))
+    batch = {"mask": mask}
+    inj.pre_round(0, {}, batch)
+    got = np.asarray(batch["mask"])
+    assert got[2].sum() == 0 and got[5].sum() == 0 and got[0].sum() == 16
+    inj.post_round(0, batch)
+    assert batch["mask"] is mask         # the ORIGINAL array object
+
+
+def test_exclude_drops_offenders_future_faults():
+    inj = _injector({"faults": [
+        {"kind": "nan_update", "round": 3, "clients": [1]},
+        {"kind": "nan_update", "round": 5, "clients": [1]},
+        {"kind": "straggler", "round": 6, "clients": [0],
+         "delay_s": 0.01}]})
+    inj.exclude([1])
+    # Client 1 left the federation: its NaN faults are gone, client 0's
+    # straggler stays.
+    assert inj.armed_count == 1
+
+
+# ---------------------------------------------- run_experiment integration
+def test_nan_fault_halts_by_default(tmp_path):
+    cfg = _cfg(fault_plan=NAN_PLAN,
+               checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2)
+    res = run_experiment(cfg, verbose=False)
+    assert res.diverged and res.rounds_run == 3
+
+
+def test_nan_rollback_recovers_bitwise(tmp_path, baseline):
+    cfg = _cfg(fault_plan=NAN_PLAN, on_divergence="rollback",
+               checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2)
+    res = run_experiment(cfg, verbose=False)
+    assert not res.diverged and res.rounds_run == ROUNDS
+    # The replay is round-keyed: recovery is exact, not approximate.
+    for k in baseline.global_metrics:
+        np.testing.assert_array_equal(res.global_metrics[k],
+                                      baseline.global_metrics[k])
+
+
+def test_rollback_budget_exhausted_halts(tmp_path):
+    plan = json.dumps({"seed": 0, "faults": [
+        {"kind": "nan_update", "round": 3, "clients": [1]},
+        {"kind": "nan_update", "round": 4, "clients": [2]}]})
+    cfg = _cfg(fault_plan=plan, on_divergence="rollback",
+               rollback_retries=1,
+               checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2)
+    res = run_experiment(cfg, verbose=False)
+    # Retry 1 replays round 3 cleanly; round 4's fresh NaN exceeds the
+    # run budget -> the ordinary halt path.
+    assert res.diverged and res.rounds_run == 4
+
+
+def test_rollback_exclude_removes_offender(tmp_path):
+    ev = str(tmp_path / "ev.jsonl")
+    from fedtpu.config import TelemetryConfig
+    cfg = _cfg(fault_plan=NAN_PLAN, on_divergence="rollback",
+               rollback_exclude=True,
+               checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+               telemetry=TelemetryConfig(events_path=ev))
+    res = run_experiment(cfg, verbose=False)
+    assert not res.diverged and res.rounds_run == ROUNDS
+    from fedtpu.telemetry.report import aggregate, load_events
+    agg = aggregate(*load_events(ev))
+    assert agg["resilience"]["exclusions"][0]["clients"] == [1]
+    assert len(agg["resilience"]["rollbacks"]) == 1
+    assert agg["counters"]["clients_excluded"] == 1
+
+
+def test_preempt_drains_checkpoint_and_resume_matches(tmp_path, baseline):
+    ck = str(tmp_path / "ck")
+    plan = json.dumps({"seed": 0, "faults": [
+        {"kind": "process_kill", "round": 3, "signal": "SIGTERM"}]})
+    cfg = _cfg(fault_plan=plan, checkpoint_dir=ck, checkpoint_every=2)
+    with pytest.raises(Preempted) as exc:
+        run_experiment(cfg, verbose=False)
+    assert exc.value.round == 3
+    from fedtpu.orchestration.checkpoint import latest_step
+    assert latest_step(ck) == 3          # the drain's checkpoint
+    # Resume finishes the job with exactly the uninterrupted history
+    # (the drained fault was consumed; resume starts past its round).
+    res = run_experiment(cfg, verbose=False, resume=True)
+    assert res.rounds_run == ROUNDS and not res.diverged
+    for k in baseline.global_metrics:
+        np.testing.assert_array_equal(res.global_metrics[k],
+                                      baseline.global_metrics[k])
+
+
+def test_run_writes_heartbeat(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    res = run_experiment(_cfg(rounds=2, heartbeat_file=hb), verbose=False)
+    beat = read_heartbeat(hb)
+    assert res.rounds_run == 2
+    assert beat["status"] == "done" and beat["round"] == 2
+    assert beat["restarts"] == 0 and beat["pid"] == os.getpid()
+
+
+@pytest.mark.parametrize("run_kw,match", [
+    ({"on_divergence": "retry"}, "on_divergence"),
+    ({"on_divergence": "rollback"}, "checkpoint"),
+    ({"on_divergence": "rollback", "checkpoint_dir": "d",
+      "checkpoint_every": 2, "pipelined_stop": True}, "pipelined"),
+    ({"rollback_exclude": True}, "rollback_exclude"),
+])
+def test_invalid_resilience_configs_rejected(run_kw, match):
+    with pytest.raises(ValueError, match=match):
+        run_experiment(_cfg(**run_kw), verbose=False)
+
+
+def test_corrupt_checkpoint_fallback_restores_previous_round(tmp_path):
+    ck = str(tmp_path / "ck")
+    cfg = _cfg(rounds=4, checkpoint_dir=ck, checkpoint_every=1)
+    run_experiment(cfg, verbose=False)
+    from fedtpu.orchestration.checkpoint import (complete_steps,
+                                                 load_checkpoint_fallback)
+    from fedtpu.orchestration.loop import build_experiment
+    assert corrupt_checkpoint(ck) == 4
+    assert complete_steps(ck)[-1] == 4   # still LOOKS committed
+    exp = build_experiment(cfg)
+    with pytest.warns(RuntimeWarning, match="round 4 failed to restore"):
+        _, _, step = load_checkpoint_fallback(ck, state_like=exp.state)
+    assert step == 3                     # newest round that actually loads
+
+
+# -------------------------------------------------------------- heartbeat
+def test_heartbeat_roundtrip_and_garbage(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    assert read_heartbeat(hb) is None                    # missing
+    write_heartbeat(hb, status="running", round=7)
+    beat = read_heartbeat(hb)
+    assert beat["status"] == "running" and beat["round"] == 7
+    assert beat["pid"] == os.getpid() and beat["time"] <= time.time()
+    with open(hb, "w") as fh:
+        fh.write('{"torn')
+    assert read_heartbeat(hb) is None                    # mid-crash junk
+    assert not [f for f in os.listdir(tmp_path)
+                if ".tmp." in f]                         # atomic: no litter
+
+
+# ----------------------------------------------- supervisor (scripted kids)
+# Children are tiny `python -c` scripts via the test-only _cmd_prefix:
+# each run appends its FEDTPU_RESTARTS to a log file and exits per script,
+# so every assertion below reads the actual launch sequence.
+def _script(body):
+    return ("import os, sys\n"
+            "log = sys.argv[1]\n"
+            "n = sum(1 for _ in open(log)) if os.path.exists(log) else 0\n"
+            "open(log, 'a').write(os.environ['FEDTPU_RESTARTS'] + '\\n')\n"
+            + body)
+
+
+def _supervise(tmp_path, body, **kw):
+    log = tmp_path / "launches.txt"
+    kw.setdefault("backoff_base", 0.01)
+    kw.setdefault("backoff_max", 0.05)
+    rc = supervise([str(log)],
+                   events=str(tmp_path / "ev.jsonl"), verbose=False,
+                   _cmd_prefix=[__import__("sys").executable, "-c",
+                                _script(body)], **kw)
+    launches = (log.read_text().splitlines()
+                if log.exists() else [])
+    return rc, launches
+
+
+def test_supervisor_restarts_crash_then_succeeds(tmp_path):
+    rc, launches = _supervise(tmp_path,
+                              "sys.exit(0 if n >= 1 else 9)",
+                              max_restarts=2)
+    assert rc == 0
+    assert launches == ["0", "1"]        # FEDTPU_RESTARTS per launch
+
+
+def test_supervisor_never_restarts_divergence(tmp_path):
+    rc, launches = _supervise(tmp_path, "sys.exit(3)", max_restarts=5)
+    assert rc == 3 and launches == ["0"]
+
+
+def test_supervisor_budget_exhausted_returns_last_rc(tmp_path):
+    rc, launches = _supervise(tmp_path, "sys.exit(9)", max_restarts=1)
+    assert rc == 9 and launches == ["0", "1"]
+
+
+def test_supervisor_preemption_restarts_without_backoff(tmp_path):
+    t0 = time.time()
+    rc, launches = _supervise(
+        tmp_path, f"sys.exit(0 if n >= 1 else {EXIT_PREEMPTED})",
+        max_restarts=2, backoff_base=30.0)
+    # A 30 s crash backoff would blow this bound; preemption skips it.
+    assert rc == 0 and launches == ["0", "1"]
+    assert time.time() - t0 < 20
+
+
+def test_supervisor_hang_detection_kills_stale_child(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    write_heartbeat(hb, status="running", round=1)
+    rc, launches = _supervise(
+        tmp_path, "import time\ntime.sleep(60)",
+        max_restarts=0, hang_timeout=1.0, heartbeat=hb)
+    assert rc != 0 and launches == ["0"]   # killed, budget 0 -> give up
+    ev = [json.loads(l) for l in open(tmp_path / "ev.jsonl")]
+    exits = [e for e in ev if e["kind"] == "child_exit"]
+    assert exits and exits[-1]["payload"]["hung"] is True
+
+
+# ------------------------------------------------------------------ report
+def test_report_aggregates_resilience_timeline(tmp_path):
+    ev = str(tmp_path / "ev.jsonl")
+    from fedtpu.telemetry import make_tracer
+    tracer = make_tracer(ev)
+    tracer.event("manifest", config_hash="c", restarts=1,
+                 fault_plan="abcd1234")
+    tracer.event("fault", round=4, fault="process_kill", fault_round=4,
+                 signal="SIGKILL", process_index=0)
+    tracer.event("resume", round=2)
+    tracer.event("rollback", round=5, restored_round=4, attempt=1,
+                 reason="loss/metrics at round 5")
+    tracer.event("exclusion", round=4, clients=[2])
+    tracer.event("preempted", round=6)
+    tracer.event("restart", restarts=1, rc=-9, hung=False, backoff_s=1.0,
+                 resume=True)
+    tracer.event("child_exit", rc=-9, restarts=0, hung=False)
+    tracer.event("supervisor_exit", rc=0, reason="done", restarts=1)
+    tracer.close()
+    from fedtpu.telemetry.report import aggregate, load_events, render_text
+    agg = aggregate(*load_events(ev))
+    res = agg["resilience"]
+    assert res["faults"][0]["fault"] == "process_kill"
+    assert res["rollbacks"][0]["restored_round"] == 4
+    assert res["exclusions"][0]["clients"] == [2]
+    assert res["restarts"] == 1 and res["child_exit_codes"] == [-9]
+    assert res["preempted_rounds"] == [6] and res["resume_rounds"] == [2]
+    assert res["supervisor_exit"]["reason"] == "done"
+    assert agg["manifest"]["restarts"] == 1
+    assert agg["manifest"]["fault_plan"] == "abcd1234"
+    text = render_text(agg)
+    assert "fault process_kill @ round 4" in text
+    assert "rollback @ round 5 -> restored round 4" in text
+    assert "supervisor restarts: 1" in text
