@@ -34,6 +34,10 @@ EXIT_ALLOWLIST: tuple[str, ...] = (
     "fedtpu/cli.py",
     "fedtpu/resilience/supervisor.py",
     "fedtpu/resilience/chaos.py",
+    # The collective watchdog's os._exit(75): a stuck collective cannot be
+    # unwound with an exception (the thread is blocked in native code), so
+    # the only sound move is the process-level preemption exit.
+    "fedtpu/resilience/distributed.py",
 )
 
 

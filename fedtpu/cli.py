@@ -338,6 +338,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["rollback_perturb"] = args.rollback_perturb
     if getattr(args, "heartbeat", None) is not None:
         run_kw["heartbeat_file"] = args.heartbeat
+    if getattr(args, "collective_timeout", None):
+        run_kw["collective_timeout"] = args.collective_timeout
     if args.events is not None:
         run_kw["telemetry"] = dataclasses.replace(run.telemetry,
                                                   events_path=args.events)
@@ -449,6 +451,14 @@ def build_parser() -> argparse.ArgumentParser:
                        help="liveness heartbeat file the loop rewrites "
                             "atomically every chunk ('fedtpu supervise "
                             "--hang-timeout' watches its mtime)")
+    run_p.add_argument("--collective-timeout", type=_nonnegative_float,
+                       default=None, metavar="SECONDS",
+                       help="multi-process watchdog: abort with exit 75 "
+                            "(restartable) when a blocking collective/"
+                            "fetch stalls past this many seconds — a hung "
+                            "peer becomes a gang restart, never a "
+                            "deadlock. Set it above the worst-case "
+                            "healthy chunk walltime (0 disables)")
     run_p.add_argument("--max-restarts", type=_positive_int, default=None,
                        help="self-supervise: run as a child process "
                             "auto-restarted with --resume up to N times on "
@@ -605,6 +615,12 @@ def build_parser() -> argparse.ArgumentParser:
                            help="run a fedtpu command as a supervised "
                                 "child: auto-restart with --resume on "
                                 "crash/preemption (docs/resilience.md)")
+    sup_p.add_argument("--num-processes", type=_positive_int, default=1,
+                       help="launch the child as an SPMD gang of N "
+                            "processes wired together via jax.distributed "
+                            "(all-or-nothing restarts: any member's "
+                            "crash/hang/preemption restarts the whole "
+                            "gang; default 1 = single child)")
     sup_p.add_argument("--max-restarts", type=_nonnegative_int, default=2,
                        help="restart budget (default 2); divergence "
                             "(exit 3) is never restarted")
@@ -647,8 +663,10 @@ def build_parser() -> argparse.ArgumentParser:
                                   "and report per-scenario recovery")
     chaos_p.add_argument("--scenarios", default=None, metavar="A,B",
                          help="comma-separated subset of: sigkill, "
-                              "preempt, nan_rollback, dropout, straggler "
-                              "(default: all)")
+                              "preempt, nan_rollback, dropout, straggler, "
+                              "mp_kill_worker, mp_kill_coordinator, "
+                              "mp_hang, mp_preempt (default: all; the "
+                              "mp_* rows run a 2-process gang)")
     chaos_p.add_argument("--rounds", type=_positive_int, default=10,
                          help="rounds per scenario run (default 10)")
     chaos_p.add_argument("--num-clients", type=_positive_int, default=4,
@@ -739,7 +757,7 @@ def main(argv=None) -> int:
     if args.cmd == "supervise":
         # Before the platform pin: the supervisor parent never imports
         # jax — it only forks children, so it survives backend crashes.
-        from fedtpu.resilience.supervisor import supervise
+        from fedtpu.resilience.supervisor import supervise, supervise_gang
         child = list(args.child)
         if child and child[0] == "--":
             child = child[1:]
@@ -748,6 +766,16 @@ def main(argv=None) -> int:
                 "fedtpu supervise: give the child command after '--', "
                 "e.g. fedtpu supervise -- run --rounds 100 "
                 "--checkpoint-dir d --checkpoint-every 10")
+        if args.num_processes > 1:
+            return supervise_gang(child, num_processes=args.num_processes,
+                                  max_restarts=args.max_restarts,
+                                  backoff_base=args.backoff,
+                                  backoff_max=args.backoff_max,
+                                  grace=args.grace,
+                                  hang_timeout=args.hang_timeout,
+                                  heartbeat=args.heartbeat,
+                                  events=args.events,
+                                  verbose=not args.quiet)
         return supervise(child, max_restarts=args.max_restarts,
                          backoff_base=args.backoff,
                          backoff_max=args.backoff_max,
@@ -787,6 +815,12 @@ def main(argv=None) -> int:
         # process. Mirrors tests/conftest.py's hermetic pin.
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+    # Gang child? supervise_gang sets FEDTPU_COORDINATOR & friends per
+    # child; wire into the shared jax.distributed runtime BEFORE any
+    # other backend touch (the compilation-cache config below counts).
+    from fedtpu.parallel.multihost import initialize_from_env
+    initialize_from_env()
 
     if getattr(args, "compilation_cache", None):
         # Before any compile: every subcommand's first jit lands in (or is

@@ -345,8 +345,18 @@ class RunConfig:
     # needs a different restart point. 0 disables.
     rollback_perturb: float = 1e-6
     # Liveness heartbeat file the loop rewrites atomically every chunk
-    # (written by process 0 only); monitored by `fedtpu supervise`.
+    # (multi-process: each process writes its own derived path, see
+    # fedtpu.resilience.distributed.heartbeat_path_for); monitored by
+    # `fedtpu supervise`.
     heartbeat_file: Optional[str] = None
+    # Collective watchdog (multi-process): abort with exit 75 when a
+    # blocking host fetch / collective checkpoint stalls past this many
+    # seconds — a hung peer becomes a restartable crash for the gang
+    # supervisor instead of a silent deadlock. Must exceed the
+    # worst-case HEALTHY chunk walltime (compile time excluded: the
+    # watchdog only arms around blocking fetches, not dispatch).
+    # None/0 = disabled.
+    collective_timeout: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -244,7 +244,8 @@ def peek_num_clients(directory: str, step: Optional[int] = None
     return None if nc is None else int(np.asarray(nc))
 
 
-def load_checkpoint_fallback(directory: str, sharding=None, state_like=None
+def load_checkpoint_fallback(directory: str, sharding=None, state_like=None,
+                             max_step: Optional[int] = None
                              ) -> Tuple[dict, dict, int]:
     """``load_checkpoint`` of the NEWEST checkpoint that actually
     restores, walking complete steps newest-first past corrupt rounds.
@@ -255,8 +256,14 @@ def load_checkpoint_fallback(directory: str, sharding=None, state_like=None
     exactly this). A restore failure on the latest round must not strand
     a resumable run when an older good round exists, so each failure is
     warned about, counted (``checkpoint_restore_corrupt``), and skipped.
-    Raises FileNotFoundError when no checkpoint loads at all."""
+    Raises FileNotFoundError when no checkpoint loads at all.
+
+    ``max_step`` bounds the walk: on a multi-process resume the gang has
+    AGREED on a common step (fedtpu.resilience.distributed), and a
+    process restoring anything newer would desync the federation."""
     steps = complete_steps(directory)
+    if max_step is not None:
+        steps = [s for s in steps if s <= max_step]
     last_err: Optional[Exception] = None
     for step in reversed(steps):
         try:
@@ -320,7 +327,15 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
             if sh is None:
                 return l                      # already a fine global array
             return jax.jit(identity, out_shardings=sh)(l)  # fedtpu: noqa[FTP006] one-shot resume-time reshard, not a hot path
-        return jax.device_put(l) if sh is None else jax.device_put(l, sh)
+        if sh is None:
+            return jax.device_put(l)
+        # safe_put: a host leaf onto a cross-process sharding would run an
+        # implicit per-leaf equality broadcast under jax.distributed
+        # (fedtpu.parallel.multihost.safe_put) — resume replays one per
+        # restored leaf, exactly when a freshly restarted gang is most
+        # sensitive to collective misalignment.
+        from fedtpu.parallel.multihost import safe_put
+        return safe_put(l, sh)
 
     if state_like is not None and any(
             _mesh_sharding(l) is not None for l in jax.tree.leaves(state_like)):

@@ -59,6 +59,8 @@ from fedtpu.orchestration.checkpoint import (complete_steps,
                                              retain_checkpoints,
                                              save_checkpoint)
 from fedtpu.orchestration.privacy import PrivacyLedger
+from fedtpu.resilience.distributed import (CollectiveWatchdog,
+                                           heartbeat_path_for)
 from fedtpu.resilience.supervisor import Preempted, write_heartbeat
 from fedtpu.parallel.mesh import make_mesh, client_sharding
 from fedtpu.telemetry import (TelemetryLogger, build_manifest,
@@ -417,10 +419,14 @@ def build_experiment(cfg: ExperimentConfig,
             byzantine_clients=cfg.fed.byzantine_clients,
             scaffold=cfg.fed.scaffold)
 
+    # safe_put: plain device_put of a host value onto a cross-process
+    # sharding runs an implicit per-array equality broadcast under
+    # jax.distributed (fedtpu.parallel.multihost.safe_put).
+    from fedtpu.parallel.multihost import safe_put
     batch = {
-        "x": jax.device_put(packed.x, shard),
-        "y": jax.device_put(packed.y, shard),
-        "mask": jax.device_put(packed.mask, shard),
+        "x": safe_put(packed.x, shard),
+        "y": safe_put(packed.y, shard),
+        "mask": safe_put(packed.mask, shard),
     }
     state = state_fn()
 
@@ -487,8 +493,9 @@ def _bcast_into_slots(global_np, live_params):
     params, preserving each leaf's per-leaf sharding and dtype. Shared by
     elastic resume and the init_weights warm start — keep them from
     drifting apart."""
+    from fedtpu.parallel.multihost import safe_put
     return jax.tree.map(
-        lambda g, p: jax.device_put(
+        lambda g, p: safe_put(
             np.broadcast_to(np.asarray(g)[None], p.shape).astype(p.dtype),
             p.sharding),
         global_np, live_params)
@@ -624,16 +631,41 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             preempt["sig"] = signum
         _prev_term = signal.signal(signal.SIGTERM, _on_term)
 
-    heartbeat = cfg.run.heartbeat_file if io_proc else None
+    # Liveness: EVERY process writes its own derived heartbeat path
+    # (process 0 keeps the configured base, peers get .p<i>) so the gang
+    # supervisor can tell a wedged worker from a healthy gang — a single
+    # shared file would let any one live process mask a hung peer.
+    heartbeat = (heartbeat_path_for(cfg.run.heartbeat_file,
+                                    jax.process_index())
+                 if cfg.run.heartbeat_file else None)
 
     def _beat(status: str, rnd: int) -> None:
-        """Liveness heartbeat (atomic rewrite, process 0 only): the
+        """Liveness heartbeat (atomic rewrite, one file per process): the
         supervisor's --hang-timeout reads its mtime."""
         if heartbeat:
             write_heartbeat(heartbeat, status=status, round=rnd,
                             restarts=restart_count)
 
     _beat("starting", 0)
+
+    # Collective watchdog: armed only around the loop's BLOCKING windows
+    # (warm round dispatch, chunk metric fetch, held-out-eval fetch,
+    # collective checkpoint save) — the FIRST dispatch at each chunk
+    # width is excluded, so compile time never counts against the
+    # timeout. Fires from any process (non-io processes append the
+    # collective_hang event to the sink directly) and turns the hang
+    # into exit 75, which the gang supervisor answers with a gang
+    # restart. See fedtpu.resilience.distributed.
+    watchdog = None
+    if cfg.run.collective_timeout:
+        watchdog = CollectiveWatchdog(
+            cfg.run.collective_timeout, events_path=tel.events_path,
+            process_index=jax.process_index(), heartbeat=heartbeat,
+            restart_count=restart_count).start()
+        _guard = watchdog.guard
+    else:
+        from contextlib import nullcontext
+        _guard = lambda phase, rnd=None: nullcontext()
 
     # Overlap compile (fedtpu.compilation): the rounds_per_step-wide chunk
     # program builds on a background thread — from abstract avals, through
@@ -742,11 +774,36 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         from fedtpu.orchestration.checkpoint import (
             latest_step, load_checkpoint_fallback, load_checkpoint_raw,
             load_meta, saved_num_clients)
-        if latest_step(cfg.run.checkpoint_dir) is not None:
+        agreed_step = None
+        local_latest = latest_step(cfg.run.checkpoint_dir)
+        if multiproc:
+            # Cross-host checkpoint agreement: a worker that died mid-save
+            # (or a filesystem syncing unevenly) can leave processes seeing
+            # DIFFERENT latest complete rounds — restoring each process's
+            # own latest would silently desync the gang. Exchange the
+            # locally-visible latest step and restore the minimum common
+            # one; when any process sees none, ALL start fresh together.
+            from fedtpu.resilience.distributed import (NO_CHECKPOINT,
+                                                       agree_resume_step)
+            agreed_step = agree_resume_step(
+                cfg.run.checkpoint_dir, jax.process_index(),
+                jax.process_count(), local_latest,
+                restart_count=restart_count)
+            if agreed_step == NO_CHECKPOINT:
+                log.info("Resume agreement: no complete checkpoint common "
+                         "to the whole gang; starting fresh consensually.")
+                agreed_step = None
+                local_latest = None
+            elif agreed_step != local_latest:
+                log.info(f"Resume agreement: restoring round {agreed_step}"
+                         f" (local latest: {local_latest}) — the newest "
+                         "step every process can see.")
+        if local_latest is not None:
             # ONE meta read serves elastic detection AND the DP RDP-curve
             # restore below; only a count MISMATCH (or a pre-num_clients
             # checkpoint) pays the raw state read.
-            restored_meta = load_meta(cfg.run.checkpoint_dir)
+            restored_meta = load_meta(cfg.run.checkpoint_dir,
+                                      step=agreed_step)
             # Engine kind gate FIRST, from the meta item alone: a
             # cross-engine resume at the SAME client count used to sail
             # past the count comparison into the template restore, where
@@ -770,11 +827,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             saved_c = None if nc is None else int(np.asarray(nc))
             if saved_c is None:
                 raw, raw_history, raw_round = load_checkpoint_raw(
-                    cfg.run.checkpoint_dir)
+                    cfg.run.checkpoint_dir, step=agreed_step)
                 saved_c = saved_num_clients(raw)
             elif saved_c != cfg.shard.num_clients:
                 raw, raw_history, raw_round = load_checkpoint_raw(
-                    cfg.run.checkpoint_dir)
+                    cfg.run.checkpoint_dir, step=agreed_step)
             if saved_c == cfg.shard.num_clients:
                 # Per-leaf shardings come from the live state template, so
                 # the 2-D engine's tensor-parallel layout survives resume.
@@ -783,7 +840,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # that actually restores instead of stranding the run.
                 state, restored_history, start_round = \
                     load_checkpoint_fallback(cfg.run.checkpoint_dir,
-                                             state_like=state)
+                                             state_like=state,
+                                             max_step=agreed_step)
                 if start_round != int(np.asarray(restored_meta["step"])):
                     # The ledger (DP RDP curve) must come from the round
                     # actually restored, not the corrupt latest.
@@ -791,6 +849,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                                               step=start_round)
                 log.info(f"Resumed from checkpoint at round {start_round}.")
             else:
+                from fedtpu.parallel.multihost import safe_put
                 if ("anchors" in state) != ("anchors" in raw):
                     # Engine mismatch either way: async state is NOT
                     # post-averaging (slots hold distinct local models),
@@ -825,7 +884,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     state["params"] = _bcast_into_slots(g, state["params"])
                     state["anchors"] = _bcast_into_slots(g,
                                                          state["anchors"])
-                    state["pull_tick"] = jax.device_put(
+                    state["pull_tick"] = safe_put(
                         np.full(cfg.shard.num_clients, raw_round, np.int32),
                         state["pull_tick"].sharding)
                     state["round"] = jnp.asarray(raw_round, jnp.int32)
@@ -856,7 +915,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     if ("server_opt_state" in raw
                             and "server_opt_state" in state):
                         state["server_opt_state"] = jax.tree.map(
-                            lambda live, rawv: jax.device_put(
+                            lambda live, rawv: safe_put(
                                 np.asarray(rawv), live.sharding),
                             state["server_opt_state"],
                             raw["server_opt_state"])
@@ -864,7 +923,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                         # The adaptive clip is client-count-independent
                         # server state — carry it like the server
                         # optimizer state.
-                        state["dp_clip"] = jax.device_put(
+                        state["dp_clip"] = safe_put(
                             np.asarray(raw["dp_clip"]),
                             state["dp_clip"].sharding)
                     state["round"] = jnp.asarray(raw_round, jnp.int32)
@@ -1123,11 +1182,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # (block_until_ready does not synchronize on this transport).
             # Multi-process: replicate first (collective, every process) so
             # the client-sharded leaves become host-addressable everywhere.
-            metrics = _rep(metrics)
-            for leaf in jax.tree.leaves(metrics):
-                if hasattr(leaf, "copy_to_host_async"):
-                    leaf.copy_to_host_async()
-            metrics = jax.tree.map(np.asarray, metrics)
+            with _guard("chunk_fetch", rnd0 + take):
+                metrics = _rep(metrics)
+                for leaf in jax.tree.leaves(metrics):
+                    if hasattr(leaf, "copy_to_host_async"):
+                        leaf.copy_to_host_async()
+                metrics = jax.tree.map(np.asarray, metrics)
             per_round = _unstack_metrics(metrics, take)
             dt = timer.lap() / take
             # The chunk span closes HERE, on the np.asarray materialization
@@ -1284,7 +1344,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     pending = None
                 if not stopped_early:
                     if not (cfg.run.halt_on_nonfinite and state_poisoned()):
-                        with tracer.span("checkpoint", round=rnd):
+                        with tracer.span("checkpoint", round=rnd), \
+                                _guard("checkpoint", rnd):
                             save_checkpoint(
                                 cfg.run.checkpoint_dir, state, history, rnd,
                                 extra_meta=ledger.checkpoint_meta(rnd))
@@ -1334,7 +1395,15 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 with tracer.span("compile", round=rnd + take, rounds=take):
                     state, metrics = get_step(take)(state, batch)
             else:
-                state, metrics = get_step(take)(state, batch)
+                # Guarded: on the CPU/gloo backend a dispatch whose
+                # collectives wait on a dead peer blocks HERE, not at the
+                # metric fetch (TPU dispatch is async, so this guard
+                # window is microseconds there). The first-call branch
+                # above stays unguarded — compile time must never count
+                # against --collective-timeout; a hang during a first
+                # dispatch is the supervisor --hang-timeout's job.
+                with _guard("dispatch", rnd + take):
+                    state, metrics = get_step(take)(state, batch)
             if injector is not None:
                 # After dispatch (the launched chunk holds its own array
                 # references): restore the pre-fault mask so every later
@@ -1421,11 +1490,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # host-addressable from every process; replicated params
                 # also make the eval jit's output fetchable everywhere.
                 sp = tracer.span("eval", round=rnd)
-                tm = eval_step(_rep(exp.global_fn(state)),
-                               ds.x_test, ds.y_test)
-                # Span closes on the host fetch of the eval metrics — the
-                # fetch-forced-completion rule again.
-                sp.end_after_fetch(tm)
+                with _guard("eval_fetch", rnd):
+                    tm = eval_step(_rep(exp.global_fn(state)),
+                                   ds.x_test, ds.y_test)
+                    # Span closes on the host fetch of the eval metrics —
+                    # the fetch-forced-completion rule again.
+                    sp.end_after_fetch(tm)
                 registry.counter("held_out_evals").inc()
                 for _ in range(eval_due):
                     for k in METRIC_NAMES:
@@ -1444,7 +1514,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # collective (barriers internally — a process-0-only call
                 # deadlocks), and it writes each client shard from the
                 # process that owns it (true distributed checkpointing).
-                with tracer.span("checkpoint", round=rnd):
+                with tracer.span("checkpoint", round=rnd), \
+                        _guard("checkpoint", rnd):
                     save_checkpoint(cfg.run.checkpoint_dir, state, history,
                                     rnd,
                                     extra_meta=ledger.checkpoint_meta(rnd))
@@ -1468,6 +1539,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             halt_diverged(f"params/optimizer state after round {rnd}", rnd)
 
     finally:
+        if watchdog is not None:
+            # Post-loop fetches (final params, personalization) run
+            # unguarded — a healthy completion reached them, and the
+            # watchdog must never fire on epilogue work it can't see.
+            watchdog.stop()
         if _prev_term is not None:
             signal.signal(signal.SIGTERM, _prev_term)
         if overlap_exec is not None:
@@ -1554,6 +1630,25 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                      f"{dp['noise_multiplier']}, sampling rate "
                      f"{dp['sampling_rate']}, {dp['rounds']} rounds; RDP "
                      f"order {dp['rdp_order']}{notes})")
+    if (cfg.fed.async_mode and cfg.fed.async_buffer_size >= 2
+            and not diverged and "buf_count" in state):
+        # K-buffer starvation guard (VERDICT item 7): with --buffer-size
+        # large relative to arrivals the buffer may never fill, so the
+        # global silently never moves. The run is still sound — metrics
+        # recorded, checkpoints/resume carry the pending buffer — but
+        # the user must hear that their contributions were never applied.
+        pending = int(np.asarray(jax.device_get(_rep(state["buf_count"]))))
+        if pending > 0:
+            log.warning(
+                f"ASYNC K-BUFFER STARVATION: {pending} buffered update(s) "
+                f"never reached --buffer-size {cfg.fed.async_buffer_size} "
+                f"by the final tick, so the global model did not advance "
+                "on them. Lower --buffer-size or raise --arrival-rate/"
+                "--rounds; a resumed run carries the pending buffer "
+                "forward.")
+            tracer.event("async_starvation", round=rounds_run,
+                         pending=pending,
+                         buffer_size=cfg.fed.async_buffer_size)
     _beat("diverged" if diverged else "done", rounds_run)
     tracer.event("run_end", round=rounds_run, stopped_early=stopped_early,
                  diverged=diverged, rounds_trained=result.rounds_trained,

@@ -89,7 +89,10 @@ def init_async_state(key: jax.Array, mesh, num_clients: int,
         lambda g, p: jnp.broadcast_to(g[None], p.shape).astype(p.dtype),
         g0, params)
     shard = client_sharding(mesh)
-    put = lambda t: jax.device_put(t, shard)
+    # safe_put: no implicit cross-process equality broadcast per leaf
+    # under jax.distributed (fedtpu.parallel.multihost.safe_put).
+    from fedtpu.parallel.multihost import safe_put
+    put = lambda t: safe_put(t, shard)
     anchors = jax.tree.map(put, anchors)
     extra = {}
     from fedtpu.parallel.mesh import replicated_sharding
@@ -97,9 +100,9 @@ def init_async_state(key: jax.Array, mesh, num_clients: int,
     if buffer_size >= 2:
         extra = {
             "buf_delta": jax.tree.map(
-                lambda gl: jax.device_put(
+                lambda gl: safe_put(
                     jnp.zeros(gl.shape, jnp.float32), rep), g0),
-            "buf_count": jax.device_put(jnp.zeros((), jnp.float32), rep),
+            "buf_count": safe_put(jnp.zeros((), jnp.float32), rep),
         }
     return {
         **extra,
@@ -115,7 +118,7 @@ def init_async_state(key: jax.Array, mesh, num_clients: int,
         "pull_tick": put(jnp.zeros((num_clients,), jnp.int32)),
         # Replicated from birth, matching the tick's output sharding — a
         # SingleDeviceSharding init retraces the second tick (fedtpu check).
-        "round": jax.device_put(jnp.zeros((), jnp.int32), rep),
+        "round": safe_put(jnp.zeros((), jnp.int32), rep),
     }
 
 

@@ -94,6 +94,27 @@ def _looks_multihost() -> bool:
     return False
 
 
+def _enable_cpu_collectives() -> None:
+    """Opt in to gloo cross-process collectives when the platform is CPU.
+
+    jax defaults ``jax_cpu_collectives_implementation`` to ``none``, under
+    which ANY multi-process computation fails with "Multiprocess
+    computations aren't implemented on the CPU backend" — including the
+    implicit psum inside ``device_put``'s cross-process equality check.
+    gloo-over-TCP is the CPU stand-in for DCN. Must run before the
+    backend is created (same contract as ``jax.distributed.initialize``);
+    TPU/GPU platforms are untouched, and older jax without the flag is
+    tolerated."""
+    import os
+    platforms = (jax.config.jax_platforms
+                 or os.environ.get("JAX_PLATFORMS", ""))
+    if platforms and platforms.split(",")[0].strip() == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # fedtpu: noqa[FTP102] flag absent in older jax — nothing to configure there
+            pass
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None, **kwargs) -> None:
@@ -124,6 +145,7 @@ def initialize(coordinator_address: Optional[str] = None,
     count.
     """
     if coordinator_address is not None or num_processes is not None:
+        _enable_cpu_collectives()
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id, **kwargs)
@@ -140,6 +162,58 @@ def initialize(coordinator_address: Optional[str] = None,
             ) from e
         # Not a pod / already-initialized single process — fine.
         return
+
+
+def initialize_from_env() -> bool:
+    """Wire this process into a gang launched by ``fedtpu supervise
+    --num-processes N`` (fedtpu.resilience.supervisor.supervise_gang).
+
+    The gang parent sets ``FEDTPU_COORDINATOR`` / ``FEDTPU_NUM_PROCESSES``
+    / ``FEDTPU_PROCESS_ID`` per child; this reads them and calls
+    ``initialize`` explicitly. Returns True when a gang environment was
+    present (and the runtime is now wired), False otherwise — so the CLI
+    can call it unconditionally before the first backend touch.
+
+    Peer-death detection note: jax's own coordination-service heartbeat
+    (~100 s at the 0.4.x defaults) is NOT the recovery latency here. The
+    gang parent sees the dead child's exit directly and tears the rest
+    down with SIGTERM-then-SIGKILL, so survivors blocked in a collective
+    are bounded by the supervisor's ``--grace``, not by jax's detector.
+    """
+    import os
+    coord = os.environ.get("FEDTPU_COORDINATOR", "")
+    if not coord:
+        return False
+    nprocs = int(os.environ["FEDTPU_NUM_PROCESSES"])
+    pid = int(os.environ["FEDTPU_PROCESS_ID"])
+    initialize(coordinator_address=coord, num_processes=nprocs,
+               process_id=pid)
+    return True
+
+
+def safe_put(x, sharding):
+    """``jax.device_put`` minus the implicit cross-process broadcast.
+
+    Putting a HOST value (numpy, or an uncommitted jax array) onto a
+    non-fully-addressable sharding makes jax run a psum-backed
+    ``multihost_utils.assert_equal`` across every process — one small
+    collective PER LEAF (jax dispatch.py, ``_device_put_sharding_impl``).
+    At gang startup/resume that is dozens of unfenced gloo/DCN broadcasts
+    before the first real round, which is both slow (O(leaves) DCN
+    round-trips on a pod) and fragile on restart (observed gloo stream
+    misalignment — ``op.preamble.length <= op.nbytes`` aborts — when a
+    freshly restarted gang replays them back-to-back).
+
+    Every fedtpu host value is derived from the shared seed, so the
+    equality check is vacuous: assemble the global array from the local
+    host value instead, which needs no cross-process traffic at all.
+    Single-process it IS ``jax.device_put`` (bitwise-identical arrays).
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
 
 
 def local_client_slice(num_clients: int, mesh) -> slice:

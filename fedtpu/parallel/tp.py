@@ -202,9 +202,12 @@ def init_federated_state_2d(key: jax.Array, mesh: Mesh, num_clients: int,
     # Replicated from birth — the step returns the counter with a
     # replicated NamedSharding, and a SingleDeviceSharding init would
     # retrace the second call (caught by `fedtpu check`).
+    # safe_put: no implicit cross-process equality broadcast per leaf
+    # under jax.distributed (fedtpu.parallel.multihost.safe_put).
+    from fedtpu.parallel.multihost import safe_put
     state = {"params": params, "opt_state": opt_state,
-             "round": jax.device_put(jnp.zeros((), jnp.int32),
-                                     NamedSharding(mesh, P()))}
+             "round": safe_put(jnp.zeros((), jnp.int32),
+                               NamedSharding(mesh, P()))}
     if server_opt is not None:
         g0 = jax.tree.map(lambda p: p[0], params)
         # f32 server accumulators regardless of param dtype, matching the
@@ -214,7 +217,7 @@ def init_federated_state_2d(key: jax.Array, mesh: Mesh, num_clients: int,
                                server_opt.init(g0))
         sspecs = jax.tree.map(drop_client_axis, specs)
         state["server_opt_state"] = jax.tree.map(
-            lambda t, s: jax.device_put(t, NamedSharding(mesh, s)),
+            lambda t, s: safe_put(t, NamedSharding(mesh, s)),
             sstate0, {k: sspecs for k in sstate0})
     return state
 

@@ -13,19 +13,28 @@ package makes failure a first-class, testable input:
   (0 done / 3 diverged / 75 preempted), heartbeat file, and
   ``fedtpu supervise`` auto-restart with ``--resume`` under bounded
   exponential backoff.
+* :mod:`fedtpu.resilience.distributed` — the multi-process layer: the
+  collective watchdog (a hung cross-host collective becomes a
+  restartable exit-75 crash), per-process heartbeat paths, and the
+  cross-host checkpoint-agreement protocol used on gang resume.
 * :mod:`fedtpu.resilience.chaos` — ``fedtpu chaos``: a scenario matrix
-  (SIGKILL, preemption, NaN rollback, dropout, straggler) with
-  per-scenario survival/recovery reporting.
+  (SIGKILL, preemption, NaN rollback, dropout, straggler, plus the
+  multi-process gang scenarios) with per-scenario survival/recovery
+  reporting.
 
 See docs/resilience.md for the fault taxonomy and recovery semantics.
 """
 
+from fedtpu.resilience.distributed import (CollectiveWatchdog,
+                                           agree_resume_step,
+                                           heartbeat_path_for)
 from fedtpu.resilience.supervisor import (EXIT_DIVERGED, EXIT_OK,
                                           EXIT_PREEMPTED, Preempted,
                                           read_heartbeat, supervise,
-                                          write_heartbeat)
+                                          supervise_gang, write_heartbeat)
 
 __all__ = [
     "EXIT_OK", "EXIT_DIVERGED", "EXIT_PREEMPTED", "Preempted",
-    "read_heartbeat", "write_heartbeat", "supervise",
+    "read_heartbeat", "write_heartbeat", "supervise", "supervise_gang",
+    "CollectiveWatchdog", "agree_resume_step", "heartbeat_path_for",
 ]

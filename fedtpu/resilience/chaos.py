@@ -19,6 +19,25 @@ the fault kills the process — then checks the recovery contract:
   straggler     One client sleeps mid-round. Survive + history bitwise
                 == baseline (wall-clock only; the math is untouched).
 
+The MULTI-PROCESS rows run the same job as a 2-process gang (two OS
+processes x two virtual CPU devices, wired by ``jax.distributed`` via
+``fedtpu supervise --num-processes 2``) against a gang-run baseline:
+
+  mp_kill_worker       SIGKILL worker 1 mid-round; the gang supervisor
+                       tears down the survivor and restarts the gang
+                       with --resume. History bitwise == gang baseline.
+  mp_kill_coordinator  Same, but process 0 — the jax.distributed
+                       coordinator — dies; the relaunch binds a fresh
+                       coordinator port. Same bar.
+  mp_hang              Worker 1 wedges before dispatching a round, so
+                       the coordinator's collective stalls; its
+                       --collective-timeout watchdog turns the hang into
+                       exit 75 (a ``collective_hang`` event) and the
+                       gang restarts. Bounded time, same bitwise bar.
+  mp_preempt           SIGTERM to EVERY process at once (the
+                       maintenance-event case): all drain the collective
+                       checkpoint, exit 75, restart without backoff.
+
 "History" is the ``--metrics-jsonl`` per-round record with timing
 stripped. Restarted/rolled-back runs append re-executed rounds to the
 same sink, so the comparison takes the LAST record per round — exactly
@@ -41,7 +60,22 @@ import sys
 import tempfile
 from typing import List, Optional, Sequence
 
-SCENARIOS = ("sigkill", "preempt", "nan_rollback", "dropout", "straggler")
+SCENARIOS = ("sigkill", "preempt", "nan_rollback", "dropout", "straggler",
+             "mp_kill_worker", "mp_kill_coordinator", "mp_hang",
+             "mp_preempt")
+
+# The gang rows: 2 OS processes x 2 virtual CPU devices each, wired into
+# one jax.distributed runtime by `supervise --num-processes 2`. Their
+# baseline is a separate uninterrupted GANG run (reduction order differs
+# across device counts, so the single-process baseline is not the right
+# bitwise reference).
+MP_SCENARIOS = ("mp_kill_worker", "mp_kill_coordinator", "mp_hang",
+                "mp_preempt")
+MP_PROCESSES = 2
+MP_DEVICES_PER_PROC = 2
+# Watchdog budget for the gang rows: far above the tiny CPU job's
+# healthy blocking window (milliseconds), far below the test timeout.
+MP_COLLECTIVE_TIMEOUT = 12.0
 
 # Metric-history fields compared across runs (sec_per_round is wall
 # clock — the one thing faults are ALLOWED to change).
@@ -65,6 +99,15 @@ def _plan(rounds: int, kind: str) -> str:
         "dropout": {"kind": "client_dropout", "round": k, "clients": [1]},
         "straggler": {"kind": "straggler", "round": k, "clients": [0],
                       "delay_s": 0.25},
+        "mp_kill_worker": {"kind": "process_kill", "round": k,
+                           "signal": "SIGKILL", "process_index": 1},
+        "mp_kill_coordinator": {"kind": "process_kill", "round": k,
+                                "signal": "SIGKILL", "process_index": 0},
+        "mp_hang": {"kind": "collective_hang", "round": k,
+                    "process_index": 1},
+        # process_index -1 = every process: the whole-slice preemption.
+        "mp_preempt": {"kind": "process_kill", "round": k,
+                       "signal": "SIGTERM", "process_index": -1},
     }[kind]
     return json.dumps({"seed": 0, "faults": [fault]})
 
@@ -74,6 +117,16 @@ def _child_env() -> dict:
     # stripping mirrors tests/test_chaos_resume.py).
     return {k: v for k, v in os.environ.items()
             if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+
+def _mp_env() -> dict:
+    # Gang children additionally need multiple virtual CPU devices per
+    # process (the supervise parent forwards its env to every gang
+    # member). num_clients must divide over the global device count.
+    env = _child_env()
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                        f"{MP_DEVICES_PER_PROC}")
+    return env
 
 
 def _run_args(workdir: str, tag: str, rounds: int, num_clients: int,
@@ -114,20 +167,30 @@ def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
                  num_clients: int, platform: str, timeout: int) -> dict:
     """One scenario run + verdict row (see module docstring for bars)."""
     ck = os.path.join(workdir, f"{name}.ck")
+    mp = name in MP_SCENARIOS
     run_args = _run_args(workdir, name, rounds, num_clients, platform)
     run_args += ["--fault-plan", _plan(rounds, name),
                  "--checkpoint-dir", ck, "--checkpoint-every", "2"]
     if name == "nan_rollback":
         run_args += ["--on-divergence", "rollback", "--rollback-retries", "2"]
-    if name in ("sigkill", "preempt"):
+    if mp:
+        # Every gang row carries the watchdog: a hang anywhere must
+        # become a restart, never a hung test (mp_hang depends on it;
+        # the kill rows get it as a backstop).
+        run_args += ["--collective-timeout", str(MP_COLLECTIVE_TIMEOUT)]
+        argv = ["supervise", "--num-processes", str(MP_PROCESSES),
+                "--max-restarts", "2", "--grace", "10", "--events",
+                os.path.join(workdir, f"{name}.events.jsonl"),
+                "--", *run_args]
+    elif name in ("sigkill", "preempt"):
         argv = ["supervise", "--max-restarts", "2", "--events",
                 os.path.join(workdir, f"{name}.events.jsonl"),
                 "--", *run_args]
     else:
         argv = run_args
     out = subprocess.run([sys.executable, "-m", "fedtpu.cli", *argv],
-                         env=_child_env(), capture_output=True, text=True,
-                         timeout=timeout)
+                         env=_mp_env() if mp else _child_env(),
+                         capture_output=True, text=True, timeout=timeout)
 
     hist = _history(os.path.join(workdir, f"{name}.metrics.jsonl"))
     res = _resilience(os.path.join(workdir, f"{name}.events.jsonl"))
@@ -150,11 +213,16 @@ def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
         "faults": len(res.get("faults") or []),
         "restarts": res.get("restarts") or 0,
         "rollbacks": len(res.get("rollbacks") or []),
+        "gang_restarts": res.get("gang_restarts") or 0,
+        "collective_hangs": len(res.get("collective_hangs") or []),
     }
     row["ok"] = (row["survived"] and row["history_match"]
                  and row["faults"] >= 1
                  and (row["restarts"] >= 1
                       if name in ("sigkill", "preempt") else True)
+                 and (row["gang_restarts"] >= 1 if mp else True)
+                 and (row["collective_hangs"] >= 1
+                      if name == "mp_hang" else True)
                  and (row["rollbacks"] >= 1
                       if name == "nan_rollback" else True))
     if not row["ok"]:
@@ -196,20 +264,54 @@ def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
                     "scenarios": [], "workdir": wd}
         baseline = _history(os.path.join(wd, "baseline.metrics.jsonl"))
 
+        mp_baseline = None
+        if any(n in MP_SCENARIOS for n in names):
+            dev = MP_PROCESSES * MP_DEVICES_PER_PROC
+            if num_clients % dev:
+                raise ValueError(
+                    f"gang scenarios need --num-clients divisible by "
+                    f"{dev} ({MP_PROCESSES} processes x "
+                    f"{MP_DEVICES_PER_PROC} devices); got {num_clients}")
+            if verbose:
+                print(f"[chaos] gang baseline ({MP_PROCESSES} processes)"
+                      f" in {wd}", flush=True)
+            # Uninterrupted gang run through the SAME launch path the
+            # fault rows use (max_restarts 0: a baseline may not retry).
+            mp_base = subprocess.run(
+                [sys.executable, "-m", "fedtpu.cli", "supervise",
+                 "--num-processes", str(MP_PROCESSES),
+                 "--max-restarts", "0", "--",
+                 *_run_args(wd, "mp_baseline", rounds, num_clients,
+                            platform)],
+                env=_mp_env(), capture_output=True, text=True,
+                timeout=timeout)
+            if mp_base.returncode != 0:
+                return {"ok": False, "error": "gang baseline run failed",
+                        "rc": mp_base.returncode,
+                        "stderr_tail": (mp_base.stderr or "")[-2000:],
+                        "scenarios": [], "workdir": wd}
+            mp_baseline = _history(
+                os.path.join(wd, "mp_baseline.metrics.jsonl"))
+
         rows = []
         for name in names:
             if verbose:
                 print(f"[chaos] scenario {name} ...", flush=True)
-            row = run_scenario(name, wd, baseline, rounds, num_clients,
-                               platform, timeout)
+            row = run_scenario(
+                name, wd,
+                mp_baseline if name in MP_SCENARIOS else baseline,
+                rounds, num_clients, platform, timeout)
             rows.append(row)
             if verbose:
                 status = "ok" if row["ok"] else "FAIL"
+                gang = (f" gang_restarts={row['gang_restarts']} "
+                        f"collective_hangs={row['collective_hangs']}"
+                        if name in MP_SCENARIOS else "")
                 print(f"[chaos]   {name}: {status} rc={row['rc']} "
                       f"survived={row['survived']} "
                       f"history_match={row['history_match']} "
                       f"faults={row['faults']} restarts={row['restarts']} "
-                      f"rollbacks={row['rollbacks']}")
+                      f"rollbacks={row['rollbacks']}{gang}")
         report = {"ok": all(r["ok"] for r in rows), "rounds": rounds,
                   "num_clients": num_clients, "scenarios": rows,
                   "workdir": wd if keep_artifacts else None}

@@ -1,0 +1,288 @@
+"""Multi-host distributed resilience: collective watchdog, per-process
+heartbeats, and cross-host checkpoint agreement.
+
+The single-process resilience layer (fedtpu.resilience.supervisor) turns
+crashes into restarts — but a MULTI-process SPMD job has a failure mode a
+single process cannot have: a peer dies or wedges and every survivor
+blocks forever inside a cross-host collective, burning accelerator time
+while making no progress (the reference's exact pathology: one dead
+``mpirun`` rank stalls every ``comm.gather``, FL_CustomMLP...:142,201).
+This module supplies the three pieces that make a gang of processes
+restartable as a unit:
+
+* **CollectiveWatchdog** — a daemon thread each process runs over its OWN
+  collectives: the round loop arms it before every blocking host fetch /
+  collective checkpoint and disarms it when the fetch completes. A
+  collective stuck past ``collective_timeout`` seconds is converted into
+  a ``collective_hang`` event (appended directly to the events JSONL —
+  the hang must be attributable post-mortem from any process) and an
+  immediate ``os._exit(75)``: the hang becomes a restartable crash under
+  the standard exit-code contract, never a silent deadlock. Exit 75
+  (EX_TEMPFAIL) is deliberate — the last periodic checkpoint is intact,
+  so the gang supervisor restarts without backoff, exactly like a
+  graceful preemption.
+* **Per-process heartbeat files** — ``heartbeat_path_for(base, i)`` maps
+  the configured ``--heartbeat`` base path to one file per process
+  (process 0 keeps the base path, so single-process tooling is
+  unchanged). The gang supervisor watches every file's mtime: a worker
+  whose loop stops beating is hung even if its OS process is alive.
+* **Checkpoint agreement** — on resume, every process publishes the
+  newest COMPLETE checkpoint step it can see locally into a small
+  protocol file under ``<checkpoint_dir>/.agreement`` and waits for all
+  peers; the gang restores from the MINIMUM common step. A worker that
+  died mid-save (or a filesystem that syncs unevenly) can therefore
+  never desync the gang: either every process restores the same round,
+  or the agreement times out loudly. The shared-dir protocol matches the
+  shared checkpoint filesystem orbax already requires; a coordinator
+  KV-store transport would work too, but would make resume depend on the
+  coordinator being up — the one process whose death we must survive.
+
+jax-free on purpose: the gang supervisor parent imports this module, and
+the supervisor's whole design is that the parent survives anything a JAX
+backend does to a child.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from fedtpu.resilience.supervisor import EXIT_PREEMPTED, write_heartbeat
+from fedtpu.telemetry.trace import EVENT_SCHEMA_VERSION
+
+# Gang-launch environment contract (set per child by supervise_gang,
+# consumed by fedtpu.parallel.multihost.initialize_from_env before any
+# backend touch). Values mirror jax.distributed.initialize's arguments.
+ENV_COORDINATOR = "FEDTPU_COORDINATOR"
+ENV_NUM_PROCESSES = "FEDTPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "FEDTPU_PROCESS_ID"
+
+# Subdirectory of the checkpoint dir holding the agreement protocol
+# files. Invisible to resume/retention: checkpoint._step_of only
+# recognizes round_* names.
+AGREEMENT_DIR = ".agreement"
+
+# Sentinel step meaning "this process sees no complete checkpoint".
+NO_CHECKPOINT = -1
+
+
+def heartbeat_path_for(base: str, process_index: int) -> str:
+    """Per-process liveness file: process 0 keeps the configured base path
+    (single-process tooling — ``fedtpu supervise --hang-timeout`` on one
+    child — is unchanged), peers get ``<base>.p<i>``."""
+    return base if process_index == 0 else f"{base}.p{process_index}"
+
+
+class CollectiveWatchdog:
+    """Turns a hung cross-process collective into a restartable crash.
+
+    Usage (the round loop)::
+
+        wd = CollectiveWatchdog(timeout=cfg.run.collective_timeout, ...)
+        wd.start()
+        with wd.guard("chunk_fetch", round_):
+            metrics = fetch(...)          # the call that can block forever
+        ...
+        wd.stop()
+
+    The timeout clock starts at guard entry, so it bounds the WHOLE
+    blocking window — device execution plus the cross-process collective
+    — and must be set above the worst-case healthy chunk walltime
+    (compile time is excluded: tracing/lowering/compilation happen at
+    dispatch, outside the guarded fetch).
+
+    On expiry the watchdog thread appends a ``collective_hang`` event to
+    the events JSONL (direct, schema-v1 — the process's tracer may belong
+    to another thread or another process entirely), stamps the heartbeat
+    file with ``status="collective_hang"`` so the supervisor's view
+    agrees, and aborts with ``os._exit(EXIT_PREEMPTED)``. ``os._exit``
+    (not ``sys.exit``): the main thread is wedged inside a C++ collective
+    and will never unwind a Python exception; the checkpointed state on
+    disk is the recovery path, not this process.
+
+    ``_abort`` is injectable for tests (the default really exits).
+    """
+
+    def __init__(self, timeout: float, events_path: Optional[str] = None,
+                 process_index: int = 0, heartbeat: Optional[str] = None,
+                 restart_count: int = 0, poll: Optional[float] = None,
+                 _abort=None):
+        if timeout <= 0:
+            raise ValueError(f"collective_timeout must be > 0, got "
+                             f"{timeout}")
+        self.timeout = float(timeout)
+        self.events_path = events_path
+        self.process_index = int(process_index)
+        self.heartbeat = heartbeat
+        self.restart_count = int(restart_count)
+        self._poll = float(poll) if poll else min(1.0, self.timeout / 4.0)
+        self._abort = _abort if _abort is not None else self._os_abort
+        self._lock = threading.Lock()
+        self._armed_at: Optional[float] = None
+        self._phase: Optional[str] = None
+        self._round: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    @staticmethod
+    def _os_abort(code: int) -> None:
+        os._exit(code)  # the hung main thread cannot unwind an exception
+
+    def start(self) -> "CollectiveWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._watch,
+                                            name="fedtpu-collective-watchdog",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self._poll)
+            self._thread = None
+
+    def arm(self, phase: str, round_: Optional[int] = None) -> None:
+        with self._lock:
+            self._armed_at = time.monotonic()
+            self._phase = phase
+            self._round = round_
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed_at = None
+            self._phase = None
+            self._round = None
+
+    @contextmanager
+    def guard(self, phase: str, round_: Optional[int] = None):
+        """Arm for the duration of one blocking collective window."""
+        self.arm(phase, round_)
+        try:
+            yield
+        finally:
+            self.disarm()
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._poll):
+            with self._lock:
+                armed_at, phase, rnd = (self._armed_at, self._phase,
+                                        self._round)
+            if armed_at is None:
+                continue
+            waited = time.monotonic() - armed_at
+            if waited > self.timeout:
+                self._fire(phase, rnd, waited)
+                return
+
+    def _fire(self, phase: Optional[str], round_: Optional[int],
+              waited: float) -> None:
+        self.fired = True
+        payload = {"process": self.process_index, "phase": phase,
+                   "timeout_s": self.timeout, "waited_s": round(waited, 3),
+                   "restarts": self.restart_count, "pid": os.getpid()}
+        if self.events_path:
+            # Direct append, flushed: the ENTIRE point is post-mortem
+            # attribution, and this thread is about to kill the process.
+            try:
+                with open(self.events_path, "a") as fh:
+                    fh.write(json.dumps({
+                        "v": EVENT_SCHEMA_VERSION, "kind": "collective_hang",
+                        "round": round_, "dur_s": round(waited, 3),
+                        "payload": payload}) + "\n")
+                    fh.flush()
+            except OSError:
+                pass                    # dying loudly beats dying silently
+        if self.heartbeat:
+            try:
+                write_heartbeat(self.heartbeat, status="collective_hang",
+                                round=round_ or 0,
+                                restarts=self.restart_count)
+            except OSError:
+                pass
+        self._abort(EXIT_PREEMPTED)
+
+
+# --------------------------------------------------- checkpoint agreement
+
+def _agreement_file(checkpoint_dir: str, process_index: int) -> str:
+    return os.path.join(os.path.abspath(checkpoint_dir), AGREEMENT_DIR,
+                        f"p{process_index}.json")
+
+
+def publish_local_step(checkpoint_dir: str, process_index: int,
+                       step: Optional[int], restart_count: int = 0) -> str:
+    """Atomically publish this process's newest locally-visible COMPLETE
+    checkpoint step (``None`` -> ``NO_CHECKPOINT``) for the current
+    restart generation. Returns the protocol file path."""
+    path = _agreement_file(checkpoint_dir, process_index)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"step": NO_CHECKPOINT if step is None else int(step),
+                   "restarts": int(restart_count), "pid": os.getpid(),
+                   "time": time.time()}, fh)
+    os.replace(tmp, path)
+    return path
+
+
+def _read_peer_step(checkpoint_dir: str, process_index: int,
+                    restart_count: int) -> Optional[int]:
+    """A peer's published step for THIS restart generation, or None (not
+    yet published / stale generation / mid-write garbage)."""
+    try:
+        with open(_agreement_file(checkpoint_dir, process_index)) as fh:
+            rec = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if rec.get("restarts") != restart_count:
+        return None                     # leftover from a previous launch
+    step = rec.get("step")
+    return int(step) if isinstance(step, int) else None
+
+
+def agree_resume_step(checkpoint_dir: str, process_index: int,
+                      process_count: int, local_step: Optional[int],
+                      restart_count: int = 0, timeout: float = 120.0,
+                      poll: float = 0.1) -> int:
+    """Publish ``local_step`` and block until every gang member has
+    published for this restart generation; returns the MINIMUM common
+    step (``NO_CHECKPOINT`` when any process sees none — the gang then
+    consensually starts fresh rather than split-brain restoring).
+
+    The generation tag (``restart_count``, identical across the gang via
+    ``FEDTPU_RESTARTS``) is what makes stale protocol files from an
+    earlier launch harmless: a reader simply ignores them until the peer
+    overwrites its file for the current generation.
+
+    Raises TimeoutError when a peer never publishes: restoring different
+    rounds on different hosts would silently corrupt the federation, so
+    no-agreement must be fatal (the gang supervisor turns the crash into
+    a clean gang restart)."""
+    publish_local_step(checkpoint_dir, process_index, local_step,
+                       restart_count)
+    deadline = time.monotonic() + timeout
+    missing = set(range(process_count))
+    steps = {}
+    while missing:
+        for i in sorted(missing):
+            s = _read_peer_step(checkpoint_dir, i, restart_count)
+            if s is not None:
+                steps[i] = s
+                missing.discard(i)
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"checkpoint agreement timed out after {timeout:.0f}s: "
+                f"process(es) {sorted(missing)} never published a resume "
+                f"step under {checkpoint_dir}/{AGREEMENT_DIR} "
+                f"(generation {restart_count}); restoring without "
+                "agreement could desync the gang")
+        time.sleep(poll)
+    return min(steps.values())

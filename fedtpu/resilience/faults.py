@@ -44,6 +44,13 @@ Fault semantics (see docs/resilience.md for the full taxonomy):
 * ``ckpt_corrupt`` — truncate + overwrite the latest complete
   checkpoint's state payload on disk: invisible to the commit check,
   caught only by the restore fallback (checkpoint.load_checkpoint_fallback).
+* ``collective_hang`` — the matching process sleeps ``delay_s`` seconds
+  (default: practically forever) BEFORE dispatching the round, so its
+  peers' cross-process collectives stall: the multi-process wedge the
+  collective watchdog (``--collective-timeout``) exists to detect. The
+  peers abort with exit 75 and the gang supervisor restarts everyone;
+  once-only like ``process_kill``. ``process_index: -1`` on the
+  process-targeted kinds means every process (the gang-wide preemption).
 """
 
 from __future__ import annotations
@@ -61,13 +68,18 @@ import jax.numpy as jnp
 import numpy as np
 
 KINDS = ("client_dropout", "straggler", "nan_update", "process_kill",
-         "ckpt_corrupt")
+         "ckpt_corrupt", "collective_hang")
 
 # Faults that must fire at most once per RUN even across supervisor
 # restarts: a restarted run resumes BELOW the fault round, so re-arming a
 # kill would loop forever (kill -> restart -> replay -> kill ...). Armed
 # only on the first launch (FEDTPU_RESTARTS == 0 / restart_count == 0).
-ONCE_KINDS = ("process_kill", "ckpt_corrupt")
+ONCE_KINDS = ("process_kill", "ckpt_corrupt", "collective_hang")
+
+# process_index=-1 on a process-targeted fault means EVERY process (the
+# gang-wide preemption case: a maintenance event SIGTERMs the whole slice
+# at once).
+ALL_PROCESSES = -1
 
 _SIGNALS = ("SIGKILL", "SIGTERM", "SIGINT")
 
@@ -96,6 +108,10 @@ class Fault:
         if self.kind == "process_kill":
             out["signal"] = self.signal
             out["process_index"] = self.process_index
+        if self.kind == "collective_hang":
+            out["process_index"] = self.process_index
+            if self.delay_s:
+                out["delay_s"] = self.delay_s
         if self.sticky:
             out["sticky"] = True
         return out
@@ -337,11 +353,20 @@ class FaultInjector:
                 state["params"] = poison_client_slots(state["params"],
                                                       f.clients)
             elif f.kind == "process_kill":
-                if self._proc == f.process_index:
+                if f.process_index in (self._proc, ALL_PROCESSES):
                     os.kill(os.getpid(), getattr(_signal, f.signal))
             elif f.kind == "ckpt_corrupt":
                 if checkpoint_dir and self._proc == 0:
                     corrupt_checkpoint(checkpoint_dir)
+            elif f.kind == "collective_hang":
+                if f.process_index in (self._proc, ALL_PROCESSES):
+                    # Wedge THIS process before it dispatches the round:
+                    # every peer's next cross-process collective now
+                    # stalls — the silent multi-host deadlock. Bounded
+                    # either by the peers' collective watchdogs (exit 75
+                    # -> gang teardown SIGKILLs this sleeper) or by
+                    # delay_s for single-process watchdog drills.
+                    time.sleep(f.delay_s if f.delay_s > 0 else 3600.0)
 
     def post_round(self, rnd: int, batch: dict) -> None:
         """Undo non-sticky per-round faults after the dispatch that

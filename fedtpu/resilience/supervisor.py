@@ -33,11 +33,12 @@ from __future__ import annotations
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 EXIT_OK = 0
 EXIT_DIVERGED = 3
@@ -194,6 +195,198 @@ def supervise(child_argv: Sequence[str], max_restarts: int = 2,
                 why = "hung" if hung else (
                     "preempted" if rc == EXIT_PREEMPTED else f"rc={rc}")
                 print(f"[supervise] child {why}; restart "
+                      f"{restarts}/{max_restarts}"
+                      + (f" after {delay:.1f}s backoff" if delay else ""))
+            if delay:
+                time.sleep(delay)
+    finally:
+        for s, h in restore:
+            signal.signal(s, h)
+        tracer.close()
+
+
+# ----------------------------------------------------- gang supervision
+
+def _free_port() -> int:
+    """A fresh coordinator port. Picked per gang LAUNCH, not per gang:
+    after a coordinator death the old socket can linger (TIME_WAIT, or a
+    not-yet-reaped child still holding it), and jax.distributed's
+    coordination service cannot rebind it — reusing the port would make
+    every coordinator-death restart flaky."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _teardown_gang(live: Dict[int, subprocess.Popen], grace: float,
+                   rcs: Dict[int, int]) -> None:
+    """All-or-nothing: SIGTERM every survivor at once (they drain in
+    parallel — some may be blocked in a collective their dead peer will
+    never join, which is exactly why SIGKILL follows after ``grace``)."""
+    for c in live.values():
+        c.terminate()
+    deadline = time.time() + grace
+    for i, c in live.items():
+        try:
+            rcs[i] = c.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            c.kill()
+            rcs[i] = c.wait()
+    live.clear()
+
+
+def _wait_gang(children: List[subprocess.Popen], signaled: dict,
+               heartbeat: Optional[str], hang_timeout: Optional[float],
+               grace: float, started: float,
+               ) -> Tuple[int, Optional[int], bool, Dict[int, int]]:
+    """Poll the gang until it finishes or one member fails. Returns
+    ``(trigger_rc, trigger_proc, hung, rcs)`` — ``trigger_proc`` is None
+    on clean completion / external stop. A member exiting ``EXIT_OK``
+    early is NOT a failure (peers finish their own epilogue); any other
+    exit, or a stale per-process heartbeat, triggers gang teardown."""
+    from fedtpu.resilience.distributed import heartbeat_path_for
+    live: Dict[int, subprocess.Popen] = dict(enumerate(children))
+    rcs: Dict[int, int] = {}
+    while live:
+        if signaled["sig"] is not None:
+            _teardown_gang(live, grace, rcs)
+            return max(rcs.values()), None, False, rcs
+        for i in list(live):
+            rc = live[i].poll()
+            if rc is None:
+                continue
+            rcs[i] = rc
+            del live[i]
+            if rc != EXIT_OK:
+                _teardown_gang(live, grace, rcs)
+                return rc, i, False, rcs
+        if hang_timeout and heartbeat:
+            for i in list(live):
+                hb = heartbeat_path_for(heartbeat, i)
+                try:
+                    last = os.path.getmtime(hb)
+                except OSError:
+                    last = started       # not written yet: age from launch
+                if time.time() - max(last, started) > hang_timeout:
+                    live[i].kill()
+                    rcs[i] = live.pop(i).wait()
+                    _teardown_gang(live, grace, rcs)
+                    return rcs[i], i, True, rcs
+        time.sleep(0.2)
+    return EXIT_OK, None, False, rcs
+
+
+def supervise_gang(child_argv: Sequence[str], num_processes: int,
+                   max_restarts: int = 2, backoff_base: float = 1.0,
+                   backoff_max: float = 30.0, grace: float = 15.0,
+                   hang_timeout: Optional[float] = None,
+                   heartbeat: Optional[str] = None,
+                   events: Optional[str] = None,
+                   extra_env: Optional[dict] = None,
+                   _cmd_prefix: Optional[List[str]] = None,
+                   verbose: bool = True) -> int:
+    """``supervise()`` for an SPMD gang of ``num_processes`` workers.
+
+    SPMD makes restarts all-or-nothing: a surviving worker is not
+    "still healthy", it is blocked inside a collective its dead peer
+    will never join. So ANY member failing — crash, divergence, hang
+    (stale per-process heartbeat), preemption, coordinator death —
+    tears down the whole gang (SIGTERM, then SIGKILL after ``grace``)
+    and the restart decision is made from the triggering exit code
+    under the same 0/3/75 contract as ``supervise``. Every relaunch
+    uses a fresh coordinator port and the same ``FEDTPU_RESTARTS`` for
+    all members (the checkpoint-agreement generation tag); restarted
+    ``run`` children get ``--resume`` and agree on a common restore
+    step via fedtpu.resilience.distributed.agree_resume_step.
+    """
+    from fedtpu.resilience.distributed import (ENV_COORDINATOR,
+                                               ENV_NUM_PROCESSES,
+                                               ENV_PROCESS_ID)
+    from fedtpu.telemetry import make_tracer
+    if num_processes < 2:
+        return supervise(child_argv, max_restarts=max_restarts,
+                         backoff_base=backoff_base, backoff_max=backoff_max,
+                         grace=grace, hang_timeout=hang_timeout,
+                         heartbeat=heartbeat, events=events,
+                         extra_env=extra_env, _cmd_prefix=_cmd_prefix,
+                         verbose=verbose)
+    tracer = make_tracer(events)
+    prefix = (list(_cmd_prefix) if _cmd_prefix is not None
+              else [sys.executable, "-m", "fedtpu.cli"])
+    base = list(child_argv)
+    is_run = bool(base) and base[0] == "run"
+    if heartbeat and is_run and "--heartbeat" not in base:
+        # One base path; each process derives its own file from it
+        # (heartbeat_path_for), and _wait_gang watches all of them.
+        base += ["--heartbeat", heartbeat]
+
+    signaled = {"sig": None}
+    restore: List[Tuple[int, object]] = []
+    if threading.current_thread() is threading.main_thread():
+        def _on_sig(signum, frame):
+            signaled["sig"] = signum
+        for s in (signal.SIGTERM, signal.SIGINT):
+            restore.append((s, signal.signal(s, _on_sig)))
+
+    restarts = 0
+    tracer.event("gang_start", num_processes=num_processes,
+                 max_restarts=max_restarts, cmd=prefix + base)
+    try:
+        while True:
+            port = _free_port()
+            argv = list(base)
+            if restarts > 0 and is_run and "--resume" not in argv:
+                argv.append("--resume")
+            children = []
+            started = time.time()
+            for i in range(num_processes):
+                env = dict(os.environ, FEDTPU_RESTARTS=str(restarts),
+                           FEDTPU_SUPERVISED="1")
+                env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+                env[ENV_NUM_PROCESSES] = str(num_processes)
+                env[ENV_PROCESS_ID] = str(i)
+                if extra_env:
+                    env.update(extra_env)
+                child = subprocess.Popen(prefix + argv, env=env)
+                children.append(child)
+                tracer.event("child_start", pid=child.pid, proc=i,
+                             restarts=restarts)
+            rc, proc, hung, rcs = _wait_gang(children, signaled, heartbeat,
+                                             hang_timeout, grace, started)
+            tracer.event("child_exit", rc=rc, proc=proc, restarts=restarts,
+                         hung=hung, dur_s=time.time() - started,
+                         gang_rcs=[rcs.get(i) for i in
+                                   range(num_processes)])
+            if signaled["sig"] is not None:
+                tracer.event("supervisor_exit", rc=rc, reason="signaled",
+                             restarts=restarts)
+                return rc
+            if rc in (EXIT_OK, EXIT_DIVERGED):
+                tracer.event("supervisor_exit", rc=rc,
+                             reason="done" if rc == EXIT_OK else "diverged",
+                             restarts=restarts)
+                return rc
+            if restarts >= max_restarts:
+                tracer.event("supervisor_exit", rc=rc,
+                             reason="budget_exhausted", restarts=restarts)
+                if verbose:
+                    print(f"[supervise] gang rc={rc} (proc {proc}) with "
+                          f"restart budget exhausted ({max_restarts}); "
+                          "giving up")
+                return rc
+            delay = (0.0 if rc == EXIT_PREEMPTED
+                     else min(backoff_max, backoff_base * (2 ** restarts)))
+            restarts += 1
+            tracer.event("gang_restart", restarts=restarts, rc=rc,
+                         proc=proc, hung=hung, backoff_s=delay,
+                         resume=is_run,
+                         coordinator_died=(proc == 0))
+            if verbose:
+                why = "hung" if hung else (
+                    "preempted" if rc == EXIT_PREEMPTED else f"rc={rc}")
+                who = ("coordinator" if proc == 0 else f"worker {proc}"
+                       ) if proc is not None else "gang"
+                print(f"[supervise] {who} {why}; gang restart "
                       f"{restarts}/{max_restarts}"
                       + (f" after {delay:.1f}s backoff" if delay else ""))
             if delay:

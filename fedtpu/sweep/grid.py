@@ -317,9 +317,12 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                    "grid_size": len(hidden_grid) * len(lr_grid)}))
     shard = client_sharding(mesh)
     packed = pack_clients(ds.x_train, ds.y_train, cfg.shard)
-    x = jax.device_put(packed.x, shard)
-    y = jax.device_put(packed.y, shard)
-    mask = jax.device_put(packed.mask, shard)
+    # safe_put: no implicit cross-process equality broadcast per array
+    # under jax.distributed (fedtpu.parallel.multihost.safe_put).
+    from fedtpu.parallel.multihost import safe_put
+    x = safe_put(packed.x, shard)
+    y = safe_put(packed.y, shard)
+    mask = safe_put(packed.mask, shard)
 
     c = cfg.shard.num_clients
     adam = optax.scale_by_adam(b1=cfg.optim.b1, b2=cfg.optim.b2,
@@ -449,8 +452,8 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 jnp.repeat(p, l, axis=0)[None],
                 (c, len(archs) * l) + p.shape[1:]), stacked)
         opt_state = jax.vmap(jax.vmap(adam.init))(params)
-        params = jax.tree.map(lambda p: jax.device_put(p, shard), params)
-        opt_state = jax.tree.map(lambda p: jax.device_put(p, shard),
+        params = jax.tree.map(lambda p: safe_put(p, shard), params)
+        opt_state = jax.tree.map(lambda p: safe_put(p, shard),
                                  opt_state)
         lrs = jnp.tile(jnp.asarray(lr_group, jnp.float32), len(archs))
         exe = None
@@ -471,7 +474,7 @@ def run_grid_search(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 # replicates the uncommitted array at dispatch instead).
                 avg_params, conf, pooled_conf, mean_steps = exe(
                     params, opt_state,
-                    jax.device_put(lrs, replicated_sharding(mesh)),
+                    safe_put(lrs, replicated_sharding(mesh)),
                     x, y, mask)
             except Exception:
                 registry.counter("aot_dispatch_fallbacks").inc()

@@ -68,6 +68,8 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     rollbacks: List[dict] = []
     exclusions: List[dict] = []
     restarts: List[dict] = []
+    gang_restarts: List[dict] = []
+    collective_hangs: List[dict] = []
     child_exits: List[dict] = []
     preempted_rounds: List[int] = []
     resume_rounds: List[int] = []
@@ -110,6 +112,10 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             exclusions.append({"round": e.get("round"), **payload})
         elif kind == "restart":
             restarts.append(payload)
+        elif kind == "gang_restart":
+            gang_restarts.append(payload)
+        elif kind == "collective_hang":
+            collective_hangs.append({"round": e.get("round"), **payload})
         elif kind == "child_exit":
             child_exits.append(payload)
         elif kind == "preempted":
@@ -141,14 +147,16 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
                             "mesh_shape", "git_rev", "process_count",
                             "program", "engine", "restarts", "fault_plan")
                            if manifest.get(k) is not None}
-    if (faults or rollbacks or exclusions or restarts or child_exits
-            or preempted_rounds or resume_rounds or diverged_at
-            or supervisor_exit):
+    if (faults or rollbacks or exclusions or restarts or gang_restarts
+            or collective_hangs or child_exits or preempted_rounds
+            or resume_rounds or diverged_at or supervisor_exit):
         out["resilience"] = {
             "faults": faults,
             "rollbacks": rollbacks,
             "exclusions": exclusions,
             "restarts": len(restarts),
+            "gang_restarts": len(gang_restarts),
+            "collective_hangs": collective_hangs,
             "child_exit_codes": [c.get("rc") for c in child_exits],
             "preempted_rounds": preempted_rounds,
             "resume_rounds": resume_rounds,
@@ -236,8 +244,17 @@ def render_text(agg: dict) -> str:
         for ex in res.get("exclusions") or []:
             lines.append(f"  excluded clients {ex.get('clients')} "
                          f"@ round {ex.get('round')}")
+        for ch in res.get("collective_hangs") or []:
+            lines.append(f"  COLLECTIVE HANG @ round {ch.get('round')}: "
+                         f"process {ch.get('process')} stuck in "
+                         f"{ch.get('phase')} for {ch.get('waited_s')} s "
+                         f"(timeout {ch.get('timeout_s')} s) -> exit 75")
         if res.get("restarts"):
             lines.append(f"  supervisor restarts: {res['restarts']} "
+                         f"(child exit codes: "
+                         f"{res.get('child_exit_codes')})")
+        if res.get("gang_restarts"):
+            lines.append(f"  gang restarts: {res['gang_restarts']} "
                          f"(child exit codes: "
                          f"{res.get('child_exit_codes')})")
         if res.get("preempted_rounds"):

@@ -220,7 +220,7 @@ def pytest_collection_modifyitems(config, items):
                 f"removed tests?): {sorted(stale)}")
     uncovered = (modules_all - modules_quick
                  - {"test_multihost_e2e.py", "test_chaos_resume.py",
-                    "test_chaos_supervised.py"}
+                    "test_chaos_supervised.py", "test_gang_resilience.py"}
                  if quick_modules_expected <= modules_all else set())
     if uncovered:
         raise pytest.UsageError(

@@ -439,6 +439,42 @@ def test_buffered_state_checkpoints_and_resumes_bitwise(tmp_path):
         np.testing.assert_array_equal(a, b)
 
 
+def test_async_buffer_starvation_warns_loudly(tmp_path, capsys):
+    """K-buffer starvation guard (VERDICT item 7): --buffer-size large
+    relative to total arrivals means the buffer NEVER fills, so the
+    global silently never advances. The run must stay sound (all rounds
+    recorded) but end with a loud CLI warning + an ``async_starvation``
+    event carrying the pending count."""
+    import json
+
+    from fedtpu.config import TelemetryConfig
+    ev = str(tmp_path / "ev.jsonl")
+    base = _async_cfg(rounds=4, arrival=1.0)
+    cfg = dataclasses.replace(
+        base,
+        fed=dataclasses.replace(base.fed, async_buffer_size=10 ** 6),
+        run=RunConfig(log_every=1000,
+                      telemetry=TelemetryConfig(events_path=ev)))
+    res = run_experiment(cfg, verbose=True)
+    out = capsys.readouterr().out
+    assert "ASYNC K-BUFFER STARVATION" in out
+    assert "32 buffered update(s)" in out        # 4 ticks x 8 clients
+    assert res.rounds_run == 4
+    assert len(res.global_metrics["accuracy"]) == 4   # metrics still sound
+    sv = [json.loads(l) for l in open(ev)
+          if json.loads(l)["kind"] == "async_starvation"]
+    assert sv and sv[0]["payload"]["pending"] == 32
+    assert sv[0]["payload"]["buffer_size"] == 10 ** 6
+
+    # Control: a buffer that drains every tick (M == arrivals per tick)
+    # must not warn — the guard is about NEVER-applied contributions.
+    cfg2 = dataclasses.replace(
+        base, fed=dataclasses.replace(base.fed, async_buffer_size=8),
+        run=RunConfig(log_every=1000))
+    run_experiment(cfg2, verbose=True)
+    assert "STARVATION" not in capsys.readouterr().out
+
+
 def test_buffered_step_requires_buffered_state():
     mesh, init_fn, apply_fn, tx, batch = _fixtures()
     state = async_fed.init_async_state(jax.random.key(0), mesh, C,

@@ -26,8 +26,8 @@ import sys
 
 import pytest
 
-from fedtpu.resilience.chaos import (_child_env, _history, _run_args,
-                                     run_chaos, run_scenario)
+from fedtpu.resilience.chaos import (SCENARIOS, _child_env, _history,
+                                     _run_args, run_chaos, run_scenario)
 from fedtpu.telemetry.report import aggregate, load_events
 
 ROUNDS = 8          # fault fires at round 5 (rounds // 2 + 1)
@@ -96,11 +96,11 @@ def test_supervised_sigterm_preemption_drains_and_resumes(chaos_env):
 
 @pytest.mark.slow
 def test_full_chaos_matrix_is_green(tmp_path):
-    """The ISSUE's headline acceptance: all five scenarios in one go
-    (identical to ``fedtpu chaos --rounds 8``)."""
+    """The ISSUE's headline acceptance: every scenario — single-process
+    AND the mp_* gang rows — in one go (identical to
+    ``fedtpu chaos --rounds 8``)."""
     report = run_chaos(rounds=ROUNDS, num_clients=NUM_CLIENTS,
                        workdir=str(tmp_path), keep_artifacts=True,
                        verbose=False)
     assert report["ok"], json.dumps(report, indent=2)
-    assert [r["scenario"] for r in report["scenarios"]] == [
-        "sigkill", "preempt", "nan_rollback", "dropout", "straggler"]
+    assert [r["scenario"] for r in report["scenarios"]] == list(SCENARIOS)

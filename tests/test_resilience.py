@@ -8,6 +8,8 @@ tests/test_chaos_supervised.py."""
 import dataclasses
 import json
 import os
+import sys
+import threading
 import time
 
 import jax.numpy as jnp
@@ -19,9 +21,13 @@ from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
 from fedtpu.orchestration.loop import run_experiment
 from fedtpu.resilience.faults import (FaultInjector, FaultPlan,
                                       corrupt_checkpoint)
+from fedtpu.resilience.distributed import (NO_CHECKPOINT, CollectiveWatchdog,
+                                           agree_resume_step,
+                                           heartbeat_path_for,
+                                           publish_local_step)
 from fedtpu.resilience.supervisor import (EXIT_PREEMPTED, Preempted,
                                           read_heartbeat, supervise,
-                                          write_heartbeat)
+                                          supervise_gang, write_heartbeat)
 
 ROUNDS = 6
 NAN_PLAN = json.dumps(
@@ -324,6 +330,228 @@ def test_supervisor_hang_detection_kills_stale_child(tmp_path):
     assert exits and exits[-1]["payload"]["hung"] is True
 
 
+# ------------------------------------------------------ collective watchdog
+def test_watchdog_fires_on_stuck_guard(tmp_path):
+    ev = str(tmp_path / "ev.jsonl")
+    hb = str(tmp_path / "hb.json")
+    fired = []
+    wd = CollectiveWatchdog(0.2, events_path=ev, process_index=1,
+                            heartbeat=hb, restart_count=2, poll=0.05,
+                            _abort=fired.append).start()
+    with wd.guard("chunk_fetch", 4):
+        deadline = time.time() + 10
+        while not wd.fired and time.time() < deadline:
+            time.sleep(0.02)         # "hung" main thread, but interruptible
+    wd.stop()
+    assert fired == [EXIT_PREEMPTED] and wd.fired
+    e = json.loads(open(ev).read().splitlines()[-1])
+    assert e["kind"] == "collective_hang" and e["round"] == 4
+    assert e["payload"]["phase"] == "chunk_fetch"
+    assert e["payload"]["process"] == 1 and e["payload"]["restarts"] == 2
+    # waited is strictly > timeout at fire time, but the event rounds it
+    # to 3 decimals — which can land exactly ON the timeout.
+    assert e["payload"]["waited_s"] >= 0.2
+    assert read_heartbeat(hb)["status"] == "collective_hang"
+
+
+def test_watchdog_tolerates_fast_guards_and_idle():
+    fired = []
+    wd = CollectiveWatchdog(0.5, poll=0.02, _abort=fired.append).start()
+    for rnd in range(5):             # many short fetches, each < timeout
+        with wd.guard("chunk_fetch", rnd):
+            time.sleep(0.03)
+    time.sleep(0.6)                  # disarmed idle never counts as hung
+    wd.stop()
+    assert not fired and not wd.fired
+
+
+def test_watchdog_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError, match="collective_timeout"):
+        CollectiveWatchdog(0.0)
+
+
+# ----------------------------------------------------- checkpoint agreement
+def test_agreement_restores_minimum_common_step(tmp_path):
+    ck = str(tmp_path / "ck")
+    publish_local_step(ck, 1, 6, restart_count=1)
+    publish_local_step(ck, 2, 4, restart_count=1)
+    assert agree_resume_step(ck, 0, 3, 8, restart_count=1, timeout=5) == 4
+    # The protocol dir is invisible to checkpoint scanning.
+    from fedtpu.orchestration.checkpoint import latest_step
+    assert latest_step(ck) is None
+
+
+def test_agreement_no_checkpoint_means_consensual_fresh_start(tmp_path):
+    ck = str(tmp_path / "ck")
+    publish_local_step(ck, 1, None)
+    assert agree_resume_step(ck, 0, 2, 7, timeout=5) == NO_CHECKPOINT
+
+
+def test_agreement_ignores_stale_generation_and_times_out(tmp_path):
+    ck = str(tmp_path / "ck")
+    publish_local_step(ck, 1, 6, restart_count=0)    # previous launch
+    with pytest.raises(TimeoutError, match=r"process\(es\) \[1\]"):
+        agree_resume_step(ck, 0, 2, 6, restart_count=1, timeout=0.3,
+                          poll=0.02)
+
+
+def test_agreement_waits_for_late_peer(tmp_path):
+    ck = str(tmp_path / "ck")
+    t = threading.Timer(0.3, publish_local_step, args=(ck, 1, 2, 0))
+    t.start()
+    try:
+        assert agree_resume_step(ck, 0, 2, 5, timeout=10, poll=0.02) == 2
+    finally:
+        t.cancel()
+
+
+def test_heartbeat_path_per_process():
+    assert heartbeat_path_for("/x/hb.json", 0) == "/x/hb.json"
+    assert heartbeat_path_for("/x/hb.json", 3) == "/x/hb.json.p3"
+
+
+# ------------------------------------------------- collective_hang faults
+def test_plan_collective_hang_payload_and_once_semantics():
+    spec = {"faults": [{"kind": "collective_hang", "round": 2,
+                        "process_index": 1, "delay_s": 0.5}]}
+    plan = FaultPlan.load(spec, num_clients=8, rounds=10)
+    assert plan.faults[0].payload() == {
+        "fault": "collective_hang", "fault_round": 2,
+        "process_index": 1, "delay_s": 0.5}
+    # Once-only, like process_kill: re-arming on a restarted run would
+    # wedge -> restart -> wedge forever.
+    assert FaultInjector(plan, restart_count=1).armed_count == 0
+
+
+def test_collective_hang_wedges_only_the_matching_process():
+    spec = {"faults": [{"kind": "collective_hang", "round": 1,
+                        "process_index": 1, "delay_s": 30.0}]}
+    plan = FaultPlan.load(spec, num_clients=8, rounds=10)
+    t0 = time.time()
+    FaultInjector(plan, process_index=0).pre_round(0, {}, {})
+    assert time.time() - t0 < 5      # not this process: no sleep
+    bcast = {"faults": [{"kind": "collective_hang", "round": 1,
+                         "process_index": -1, "delay_s": 0.2}]}
+    plan = FaultPlan.load(bcast, num_clients=8, rounds=10)
+    t0 = time.time()
+    FaultInjector(plan, process_index=5).pre_round(0, {}, {})
+    assert time.time() - t0 >= 0.2   # -1 broadcasts to every process
+
+
+# --------------------------------------------- gang supervisor (scripted)
+# Same scripted-children trick as the single-process supervisor tests
+# above, but each child logs "<FEDTPU_RESTARTS> <FEDTPU_COORDINATOR>" to
+# its own per-process file so the assertions can read the whole launch
+# matrix (who ran, in which generation, against which coordinator).
+def _gang_script(body):
+    return ("import os, sys, time\n"
+            "log = sys.argv[1]\n"
+            "pid = os.environ.get('FEDTPU_PROCESS_ID', '')\n"
+            "gen = os.environ['FEDTPU_RESTARTS']\n"
+            "coord = os.environ.get('FEDTPU_COORDINATOR', '')\n"
+            "open(log + '.p' + (pid or '0'), 'a').write("
+            "gen + ' ' + coord + '\\n')\n"
+            + body)
+
+
+def _gang(tmp_path, body, num_processes=2, **kw):
+    log = tmp_path / "gang"
+    kw.setdefault("backoff_base", 0.01)
+    kw.setdefault("backoff_max", 0.05)
+    kw.setdefault("grace", 3.0)
+    rc = supervise_gang([str(log)], num_processes=num_processes,
+                        events=str(tmp_path / "gev.jsonl"), verbose=False,
+                        _cmd_prefix=[sys.executable, "-c",
+                                     _gang_script(body)], **kw)
+    launches = {}
+    for i in range(max(num_processes, 1)):
+        p = tmp_path / f"gang.p{i}"
+        launches[i] = p.read_text().splitlines() if p.exists() else []
+    events = [json.loads(l) for l in open(tmp_path / "gev.jsonl")]
+    return rc, launches, events
+
+
+def test_gang_restart_is_all_or_nothing_with_fresh_port(tmp_path):
+    rc, launches, events = _gang(
+        tmp_path,
+        "if pid == '1' and gen == '0':\n"
+        "    sys.exit(9)\n"
+        "time.sleep(0.5)\nsys.exit(0)",
+        max_restarts=2)
+    assert rc == 0
+    # BOTH processes relaunched in generation 1 — the healthy worker was
+    # torn down with its crashed peer, not left blocked in a collective.
+    assert [l.split()[0] for l in launches[0]] == ["0", "1"]
+    assert [l.split()[0] for l in launches[1]] == ["0", "1"]
+    # Fresh coordinator port per launch, identical across the gang.
+    ports = [l.split()[1] for l in launches[0]]
+    assert ports[0] != ports[1]
+    assert [l.split()[1] for l in launches[1]] == ports
+    g = [e for e in events if e["kind"] == "gang_restart"]
+    assert len(g) == 1 and g[0]["payload"]["proc"] == 1
+    assert g[0]["payload"]["coordinator_died"] is False
+
+
+def test_gang_never_restarts_divergence(tmp_path):
+    rc, launches, events = _gang(tmp_path, "sys.exit(3)", max_restarts=5)
+    assert rc == 3
+    assert launches[0] == launches[1] and len(launches[0]) == 1
+    assert not [e for e in events if e["kind"] == "gang_restart"]
+
+
+def test_gang_coordinator_death_is_flagged_and_survived(tmp_path):
+    rc, launches, events = _gang(
+        tmp_path,
+        "if pid == '0' and gen == '0':\n"
+        "    sys.exit(9)\n"
+        "time.sleep(0.5)\nsys.exit(0)",
+        max_restarts=2)
+    assert rc == 0 and len(launches[0]) == 2
+    g = [e for e in events if e["kind"] == "gang_restart"]
+    assert g and g[0]["payload"]["coordinator_died"] is True
+
+
+def test_gang_member_finishing_first_is_not_a_failure(tmp_path):
+    rc, launches, events = _gang(
+        tmp_path,
+        "if pid == '0':\n"
+        "    sys.exit(0)\n"
+        "time.sleep(0.4)\nsys.exit(0)",
+        max_restarts=2)
+    assert rc == 0
+    assert len(launches[0]) == 1 and len(launches[1]) == 1
+    assert not [e for e in events if e["kind"] == "gang_restart"]
+
+
+def test_gang_preemption_restarts_without_backoff(tmp_path):
+    t0 = time.time()
+    rc, launches, _ = _gang(
+        tmp_path,
+        f"if pid == '1' and gen == '0':\n"
+        f"    sys.exit({EXIT_PREEMPTED})\n"
+        "time.sleep(0.3)\nsys.exit(0)",
+        max_restarts=2, backoff_base=30.0)
+    # A 30 s crash backoff would blow this bound; preemption skips it.
+    assert rc == 0 and len(launches[1]) == 2
+    assert time.time() - t0 < 20
+
+
+def test_gang_hang_detection_kills_stale_member(tmp_path):
+    rc, launches, events = _gang(
+        tmp_path, "time.sleep(60)",
+        max_restarts=0, hang_timeout=1.0,
+        heartbeat=str(tmp_path / "hb.json"))
+    assert rc != 0 and len(launches[0]) == 1
+    exits = [e for e in events if e["kind"] == "child_exit"]
+    assert exits and exits[-1]["payload"]["hung"] is True
+
+
+def test_gang_of_one_delegates_to_the_single_supervisor(tmp_path):
+    rc, launches, events = _gang(tmp_path, "sys.exit(0)", num_processes=1)
+    assert rc == 0 and launches[0] == ["0 "]   # no coordinator env set
+    assert not [e for e in events if e["kind"] == "gang_start"]
+
+
 # ------------------------------------------------------------------ report
 def test_report_aggregates_resilience_timeline(tmp_path):
     ev = str(tmp_path / "ev.jsonl")
@@ -341,8 +569,18 @@ def test_report_aggregates_resilience_timeline(tmp_path):
     tracer.event("restart", restarts=1, rc=-9, hung=False, backoff_s=1.0,
                  resume=True)
     tracer.event("child_exit", rc=-9, restarts=0, hung=False)
+    tracer.event("gang_restart", restarts=1, rc=75, proc=1, hung=True,
+                 backoff_s=0.0, resume=True, coordinator_died=False)
     tracer.event("supervisor_exit", rc=0, reason="done", restarts=1)
     tracer.close()
+    # collective_hang uses the watchdog's direct wire format (the tracer
+    # claims the top-level "phase"/"round" slots for itself).
+    with open(ev, "a") as fh:
+        fh.write(json.dumps({
+            "v": 1, "kind": "collective_hang", "round": 6, "dur_s": 12.5,
+            "payload": {"process": 1, "phase": "chunk_fetch",
+                        "timeout_s": 12.0, "waited_s": 12.5,
+                        "restarts": 0, "pid": 1}}) + "\n")
     from fedtpu.telemetry.report import aggregate, load_events, render_text
     agg = aggregate(*load_events(ev))
     res = agg["resilience"]
@@ -351,6 +589,9 @@ def test_report_aggregates_resilience_timeline(tmp_path):
     assert res["exclusions"][0]["clients"] == [2]
     assert res["restarts"] == 1 and res["child_exit_codes"] == [-9]
     assert res["preempted_rounds"] == [6] and res["resume_rounds"] == [2]
+    assert res["gang_restarts"] == 1
+    assert res["collective_hangs"][0]["round"] == 6
+    assert res["collective_hangs"][0]["phase"] == "chunk_fetch"
     assert res["supervisor_exit"]["reason"] == "done"
     assert agg["manifest"]["restarts"] == 1
     assert agg["manifest"]["fault_plan"] == "abcd1234"
@@ -358,3 +599,5 @@ def test_report_aggregates_resilience_timeline(tmp_path):
     assert "fault process_kill @ round 4" in text
     assert "rollback @ round 5 -> restored round 4" in text
     assert "supervisor restarts: 1" in text
+    assert "COLLECTIVE HANG @ round 6" in text
+    assert "gang restarts: 1" in text
