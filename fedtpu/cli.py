@@ -457,8 +457,10 @@ def build_parser() -> argparse.ArgumentParser:
                             "(restartable) when a blocking collective/"
                             "fetch stalls past this many seconds — a hung "
                             "peer becomes a gang restart, never a "
-                            "deadlock. Set it above the worst-case "
-                            "healthy chunk walltime (0 disables)")
+                            "deadlock. Set it above EVERY guarded phase's "
+                            "worst-case healthy duration: the chunk "
+                            "walltime AND the collective checkpoint save, "
+                            "which scales with model size (0 disables)")
     run_p.add_argument("--max-restarts", type=_positive_int, default=None,
                        help="self-supervise: run as a child process "
                             "auto-restarted with --resume up to N times on "

@@ -352,10 +352,12 @@ class RunConfig:
     # Collective watchdog (multi-process): abort with exit 75 when a
     # blocking host fetch / collective checkpoint stalls past this many
     # seconds — a hung peer becomes a restartable crash for the gang
-    # supervisor instead of a silent deadlock. Must exceed the
-    # worst-case HEALTHY chunk walltime (compile time excluded: the
-    # watchdog only arms around blocking fetches, not dispatch).
-    # None/0 = disabled.
+    # supervisor instead of a silent deadlock. Must exceed EVERY guarded
+    # phase's worst-case HEALTHY duration: both the chunk walltime
+    # (compile time excluded: the watchdog only arms around blocking
+    # fetches, not dispatch) and the collective checkpoint save, whose
+    # duration scales with model/state size independently of chunk
+    # walltime. None/0 = disabled.
     collective_timeout: Optional[float] = None
 
 

@@ -783,12 +783,29 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # own latest would silently desync the gang. Exchange the
             # locally-visible latest step and restore the minimum common
             # one; when any process sees none, ALL start fresh together.
-            from fedtpu.resilience.distributed import (NO_CHECKPOINT,
+            from fedtpu.resilience.distributed import (ENV_LAUNCH_ID,
+                                                       NO_CHECKPOINT,
                                                        agree_resume_step)
+            launch_id = os.environ.get(ENV_LAUNCH_ID) or None
+            if launch_id is None:
+                # Manual multi-host launch (no gang parent): the
+                # generation tag must still be launch-unique, or a
+                # leftover .agreement file from a previous launch —
+                # which also had FEDTPU_RESTARTS == 0 — could hand one
+                # process a stale step while a peer reads the fresh
+                # one: the split-brain restore the agreement exists to
+                # prevent. Process 0's nonce, broadcast once, is the
+                # gang-wide launch identity.
+                from jax.experimental import multihost_utils
+                nonce = np.frombuffer(os.urandom(4), np.uint32)[0]
+                with _guard("resume_agreement"):
+                    shared = multihost_utils.broadcast_one_to_all(
+                        np.asarray(nonce, np.uint32))
+                launch_id = f"bcast:{int(shared):08x}"
             agreed_step = agree_resume_step(
                 cfg.run.checkpoint_dir, jax.process_index(),
                 jax.process_count(), local_latest,
-                restart_count=restart_count)
+                restart_count=restart_count, launch_id=launch_id)
             if agreed_step == NO_CHECKPOINT:
                 log.info("Resume agreement: no complete checkpoint common "
                          "to the whole gang; starting fresh consensually.")
@@ -941,6 +958,28 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                              f"{cfg.shard.num_clients} clients (global "
                              "model carried over, fresh client optimizer "
                              f"state{cv_note}).")
+        if multiproc:
+            # The agreement bounds the restore step, but the restore
+            # itself is per-process: load_checkpoint_fallback walks back
+            # past rounds that fail to LOAD locally, so an agreed step
+            # that is unreadable (or not yet synced) on one host leaves
+            # that host on an OLDER round than its peers — the desync
+            # the agreement exists to rule out. Cross-check the round
+            # each process ACTUALLY restored and fail loudly on
+            # mismatch: the gang supervisor turns the crash into a
+            # clean gang restart, whereas proceeding would silently
+            # corrupt the federation.
+            from jax.experimental import multihost_utils
+            with _guard("resume_verify"):
+                gang_rounds = np.asarray(multihost_utils.process_allgather(
+                    np.asarray(start_round, np.int32)))
+            if int(gang_rounds.min()) != int(gang_rounds.max()):
+                raise RuntimeError(
+                    "post-restore desync: the gang restored different "
+                    f"rounds {gang_rounds.tolist()} (agreed step: "
+                    f"{agreed_step}) — the agreed checkpoint loaded on "
+                    "some hosts but not others; refusing to train "
+                    "desynced")
 
     if restored_history is not None:
         tracer.event("resume", round=start_round)

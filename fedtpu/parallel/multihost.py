@@ -115,6 +115,43 @@ def _enable_cpu_collectives() -> None:
             pass
 
 
+def _distributed_initialize(coordinator_address, num_processes, process_id,
+                            kwargs: dict) -> None:
+    """``jax.distributed.initialize`` across jax versions. The public API
+    gained ``heartbeat_timeout_seconds`` after 0.4.x; on older jax the
+    same semantics live on the internal state initializer's
+    coordination-service knobs (interval x max-missing, defaults 10 x 10
+    = the ~100 s detection latency documented on ``initialize``), so a
+    requested timeout is translated there rather than raising TypeError
+    or silently losing the caller's detection bound."""
+    import inspect
+    kw = dict(kwargs)
+    hb = kw.pop("heartbeat_timeout_seconds", None)
+    if hb is not None:
+        params = inspect.signature(jax.distributed.initialize).parameters
+        if "heartbeat_timeout_seconds" in params:
+            kw["heartbeat_timeout_seconds"] = hb
+        else:
+            try:
+                from jax._src.distributed import global_state
+                sp = inspect.signature(global_state.initialize).parameters
+                assert "client_heartbeat_interval_seconds" in sp
+                # max_missing stays at jax's default (10); the interval
+                # carries the requested total detection bound.
+                interval = max(1, int(hb) // 10)
+                global_state.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes, process_id=process_id,
+                    service_heartbeat_interval_seconds=interval,
+                    client_heartbeat_interval_seconds=interval, **kw)
+                return
+            except Exception:  # fedtpu: noqa[FTP102] internal-API drift on some jax version: fall back to the public API and jax's default detection latency rather than failing init
+                pass
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kw)
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None, **kwargs) -> None:
@@ -146,9 +183,8 @@ def initialize(coordinator_address: Optional[str] = None,
     """
     if coordinator_address is not None or num_processes is not None:
         _enable_cpu_collectives()
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id, **kwargs)
+        _distributed_initialize(coordinator_address, num_processes,
+                                process_id, kwargs)
         return
     try:
         jax.distributed.initialize(**kwargs)
@@ -208,9 +244,22 @@ def safe_put(x, sharding):
     equality check is vacuous: assemble the global array from the local
     host value instead, which needs no cross-process traffic at all.
     Single-process it IS ``jax.device_put`` (bitwise-identical arrays).
+
+    Contract: ``x`` must be a HOST value — numpy, or a fully-addressable
+    jax Array — identical on every process. A non-fully-addressable
+    global Array is rejected (its shards cannot be materialized locally;
+    reshard it with ``jax.device_put`` instead), and a large committed
+    device array pays a device-to-host copy here, so keep device-resident
+    data on ``jax.device_put`` too.
     """
     if jax.process_count() == 1:
         return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        raise TypeError(
+            "safe_put expects a host-local value (numpy, or a "
+            "fully-addressable jax.Array) identical on every process; "
+            "got a non-fully-addressable global jax.Array — reshard "
+            "device-resident global arrays with jax.device_put instead")
     arr = np.asarray(x)
     return jax.make_array_from_callback(arr.shape, sharding,
                                         lambda idx: arr[idx])

@@ -28,8 +28,9 @@ restartable as a unit:
   whose loop stops beating is hung even if its OS process is alive.
 * **Checkpoint agreement** — on resume, every process publishes the
   newest COMPLETE checkpoint step it can see locally into a small
-  protocol file under ``<checkpoint_dir>/.agreement`` and waits for all
-  peers; the gang restores from the MINIMUM common step. A worker that
+  protocol file under ``<checkpoint_dir>/.agreement`` (tagged with the
+  launch-unique generation, see ``agree_resume_step``) and waits for
+  all peers; the gang restores from the MINIMUM common step. A worker that
   died mid-save (or a filesystem that syncs unevenly) can therefore
   never desync the gang: either every process restores the same round,
   or the agreement times out loudly. The shared-dir protocol matches the
@@ -60,6 +61,15 @@ from fedtpu.telemetry.trace import EVENT_SCHEMA_VERSION
 ENV_COORDINATOR = "FEDTPU_COORDINATOR"
 ENV_NUM_PROCESSES = "FEDTPU_NUM_PROCESSES"
 ENV_PROCESS_ID = "FEDTPU_PROCESS_ID"
+# Launch-unique nonce, identical across the gang, fresh per relaunch
+# (supervise_gang generates it; manual launches derive one via a
+# process-0 broadcast in the round loop). The checkpoint-agreement
+# generation is (launch_id, restart_count): FEDTPU_RESTARTS alone resets
+# to 0 on every NEW launch, so without the nonce a manual re-launch of
+# the same checkpoint dir could read a peer's leftover generation-0
+# protocol file from a previous life — the split-brain restore the
+# agreement exists to prevent.
+ENV_LAUNCH_ID = "FEDTPU_LAUNCH_ID"
 
 # Subdirectory of the checkpoint dir holding the agreement protocol
 # files. Invisible to resume/retention: checkpoint._step_of only
@@ -91,9 +101,11 @@ class CollectiveWatchdog:
 
     The timeout clock starts at guard entry, so it bounds the WHOLE
     blocking window — device execution plus the cross-process collective
-    — and must be set above the worst-case healthy chunk walltime
-    (compile time is excluded: tracing/lowering/compilation happen at
-    dispatch, outside the guarded fetch).
+    — and must be set above EVERY guarded phase's worst-case healthy
+    duration: the chunk walltime AND the collective checkpoint save,
+    whose duration scales with model/state size independently of chunk
+    walltime (compile time is excluded: tracing/lowering/compilation
+    happen at dispatch, outside the guarded fetch).
 
     On expiry the watchdog thread appends a ``collective_hang`` event to
     the events JSONL (direct, schema-v1 — the process's tracer may belong
@@ -216,62 +228,107 @@ def _agreement_file(checkpoint_dir: str, process_index: int) -> str:
 
 
 def publish_local_step(checkpoint_dir: str, process_index: int,
-                       step: Optional[int], restart_count: int = 0) -> str:
+                       step: Optional[int], restart_count: int = 0,
+                       launch_id: Optional[str] = None) -> str:
     """Atomically publish this process's newest locally-visible COMPLETE
     checkpoint step (``None`` -> ``NO_CHECKPOINT``) for the current
-    restart generation. Returns the protocol file path."""
+    generation (``launch_id``, ``restart_count``). Returns the protocol
+    file path."""
     path = _agreement_file(checkpoint_dir, process_index)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
         json.dump({"step": NO_CHECKPOINT if step is None else int(step),
-                   "restarts": int(restart_count), "pid": os.getpid(),
-                   "time": time.time()}, fh)
+                   "restarts": int(restart_count), "launch": launch_id,
+                   "pid": os.getpid(), "time": time.time()}, fh)
     os.replace(tmp, path)
     return path
 
 
 def _read_peer_step(checkpoint_dir: str, process_index: int,
-                    restart_count: int) -> Optional[int]:
-    """A peer's published step for THIS restart generation, or None (not
-    yet published / stale generation / mid-write garbage)."""
+                    restart_count: int,
+                    launch_id: Optional[str] = None) -> Optional[int]:
+    """A peer's published step for THIS generation, or None (not yet
+    published / stale generation or launch / mid-write garbage)."""
     try:
         with open(_agreement_file(checkpoint_dir, process_index)) as fh:
             rec = json.load(fh)
     except (OSError, ValueError):
         return None
     if rec.get("restarts") != restart_count:
+        return None                     # leftover from an earlier restart
+    if rec.get("launch") != launch_id:
         return None                     # leftover from a previous launch
     step = rec.get("step")
     return int(step) if isinstance(step, int) else None
 
 
+def _clear_stale_records(checkpoint_dir: str,
+                         launch_id: Optional[str]) -> None:
+    """Process 0's pre-publish hygiene: unlink protocol files whose launch
+    tag differs from the current one. Current-launch peers are never
+    touched (their tag matches); what goes is the previous life's
+    leftovers — including files from a LARGER previous gang that no
+    current process index would ever overwrite."""
+    agreement = os.path.join(os.path.abspath(checkpoint_dir), AGREEMENT_DIR)
+    try:
+        names = os.listdir(agreement)
+    except OSError:
+        return
+    for name in names:
+        if not (name.startswith("p") and name.endswith(".json")):
+            continue
+        path = os.path.join(agreement, name)
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            rec = {}                    # unreadable == stale
+        if rec.get("launch") != launch_id:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
 def agree_resume_step(checkpoint_dir: str, process_index: int,
                       process_count: int, local_step: Optional[int],
                       restart_count: int = 0, timeout: float = 120.0,
-                      poll: float = 0.1) -> int:
+                      poll: float = 0.1,
+                      launch_id: Optional[str] = None) -> int:
     """Publish ``local_step`` and block until every gang member has
-    published for this restart generation; returns the MINIMUM common
-    step (``NO_CHECKPOINT`` when any process sees none — the gang then
+    published for this generation; returns the MINIMUM common step
+    (``NO_CHECKPOINT`` when any process sees none — the gang then
     consensually starts fresh rather than split-brain restoring).
 
-    The generation tag (``restart_count``, identical across the gang via
-    ``FEDTPU_RESTARTS``) is what makes stale protocol files from an
-    earlier launch harmless: a reader simply ignores them until the peer
-    overwrites its file for the current generation.
+    The generation tag is the PAIR (``launch_id``, ``restart_count``):
+    ``restart_count`` (identical across the gang via ``FEDTPU_RESTARTS``)
+    distinguishes restarts within one supervised launch, and
+    ``launch_id`` (identical across the gang via ``FEDTPU_LAUNCH_ID`` or
+    a process-0 broadcast) distinguishes LAUNCHES — ``restart_count``
+    alone resets to 0 on every new launch, so a manual re-launch over
+    the same checkpoint dir would otherwise accept a peer's leftover
+    generation-0 file from a previous life and split-brain the restore.
+    Readers simply ignore records from any other generation until the
+    peer overwrites its file; process 0 additionally unlinks stale
+    records before publishing, so they cannot accumulate across
+    launches (or linger from a previously larger gang).
 
     Raises TimeoutError when a peer never publishes: restoring different
     rounds on different hosts would silently corrupt the federation, so
     no-agreement must be fatal (the gang supervisor turns the crash into
     a clean gang restart)."""
+    if process_index == 0:
+        _clear_stale_records(checkpoint_dir, launch_id)
     publish_local_step(checkpoint_dir, process_index, local_step,
-                       restart_count)
+                       restart_count, launch_id=launch_id)
     deadline = time.monotonic() + timeout
     missing = set(range(process_count))
     steps = {}
     while missing:
         for i in sorted(missing):
-            s = _read_peer_step(checkpoint_dir, i, restart_count)
+            s = _read_peer_step(checkpoint_dir, i, restart_count,
+                                launch_id=launch_id)
             if s is not None:
                 steps[i] = s
                 missing.discard(i)
@@ -282,7 +339,7 @@ def agree_resume_step(checkpoint_dir: str, process_index: int,
                 f"checkpoint agreement timed out after {timeout:.0f}s: "
                 f"process(es) {sorted(missing)} never published a resume "
                 f"step under {checkpoint_dir}/{AGREEMENT_DIR} "
-                f"(generation {restart_count}); restoring without "
-                "agreement could desync the gang")
+                f"(generation {restart_count}, launch {launch_id}); "
+                "restoring without agreement could desync the gang")
         time.sleep(poll)
     return min(steps.values())

@@ -38,6 +38,7 @@ import subprocess
 import sys
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 EXIT_OK = 0
@@ -186,7 +187,10 @@ def supervise(child_argv: Sequence[str], max_restarts: int = 2,
                     print(f"[supervise] rc={rc} with restart budget "
                           f"exhausted ({max_restarts}); giving up")
                 return rc
-            delay = (0.0 if rc == EXIT_PREEMPTED
+            # A heartbeat-detected hang is the same failure mode the
+            # watchdog's exit 75 reports (the last periodic checkpoint
+            # is intact) — both restart without backoff.
+            delay = (0.0 if rc == EXIT_PREEMPTED or hung
                      else min(backoff_max, backoff_base * (2 ** restarts)))
             restarts += 1
             tracer.event("restart", restarts=restarts, rc=rc, hung=hung,
@@ -294,12 +298,15 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
     tears down the whole gang (SIGTERM, then SIGKILL after ``grace``)
     and the restart decision is made from the triggering exit code
     under the same 0/3/75 contract as ``supervise``. Every relaunch
-    uses a fresh coordinator port and the same ``FEDTPU_RESTARTS`` for
-    all members (the checkpoint-agreement generation tag); restarted
-    ``run`` children get ``--resume`` and agree on a common restore
-    step via fedtpu.resilience.distributed.agree_resume_step.
+    uses a fresh coordinator port, a fresh gang-wide
+    ``FEDTPU_LAUNCH_ID``, and the same ``FEDTPU_RESTARTS`` for all
+    members (launch id + restart count form the launch-unique
+    checkpoint-agreement generation tag); restarted ``run`` children
+    get ``--resume`` and agree on a common restore step via
+    fedtpu.resilience.distributed.agree_resume_step.
     """
     from fedtpu.resilience.distributed import (ENV_COORDINATOR,
+                                               ENV_LAUNCH_ID,
                                                ENV_NUM_PROCESSES,
                                                ENV_PROCESS_ID)
     from fedtpu.telemetry import make_tracer
@@ -334,6 +341,12 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
     try:
         while True:
             port = _free_port()
+            # Fresh per relaunch and identical across the gang: with
+            # FEDTPU_RESTARTS this forms the launch-unique checkpoint-
+            # agreement generation (restart counters alone repeat across
+            # launches, so leftover .agreement files from a previous
+            # life could otherwise split-brain a resume).
+            launch_id = uuid.uuid4().hex[:12]
             argv = list(base)
             if restarts > 0 and is_run and "--resume" not in argv:
                 argv.append("--resume")
@@ -343,6 +356,7 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
                 env = dict(os.environ, FEDTPU_RESTARTS=str(restarts),
                            FEDTPU_SUPERVISED="1")
                 env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+                env[ENV_LAUNCH_ID] = launch_id
                 env[ENV_NUM_PROCESSES] = str(num_processes)
                 env[ENV_PROCESS_ID] = str(i)
                 if extra_env:
@@ -374,7 +388,12 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
                           f"restart budget exhausted ({max_restarts}); "
                           "giving up")
                 return rc
-            delay = (0.0 if rc == EXIT_PREEMPTED
+            # hung == heartbeat-detected hang: _wait_gang SIGKILLed the
+            # member, so rc is -9, but the failure mode is the one the
+            # collective watchdog reports as exit 75 — the last periodic
+            # checkpoint is intact, so restart without backoff exactly
+            # like a preemption.
+            delay = (0.0 if rc == EXIT_PREEMPTED or hung
                      else min(backoff_max, backoff_base * (2 ** restarts)))
             restarts += 1
             tracer.event("gang_restart", restarts=restarts, rc=rc,

@@ -405,6 +405,40 @@ def test_agreement_waits_for_late_peer(tmp_path):
         t.cancel()
 
 
+def test_agreement_ignores_same_count_leftovers_from_previous_launch(
+        tmp_path):
+    # The split-brain hole the launch nonce closes: a previous MANUAL
+    # launch (no gang parent) ran at generation 0 and left its protocol
+    # file behind; this launch is ALSO generation 0, so the restart
+    # count alone cannot tell the stale record from a fresh one. The
+    # launch tag must — reading the leftover step would restore a
+    # different round than the peer that arrives after the overwrite.
+    ck = str(tmp_path / "ck")
+    publish_local_step(ck, 1, 4, restart_count=0, launch_id="prev")
+    with pytest.raises(TimeoutError, match="launch"):
+        agree_resume_step(ck, 0, 2, 10, restart_count=0, timeout=0.3,
+                          poll=0.02, launch_id="cur")
+
+
+def test_agreement_process0_clears_previous_launch_records(tmp_path):
+    ck = str(tmp_path / "ck")
+    # Leftover from a previously LARGER gang: no current process index
+    # would ever overwrite p5.json, so only cleanup removes it.
+    stale = publish_local_step(ck, 5, 9, restart_count=2, launch_id="old")
+    t = threading.Timer(0.2, publish_local_step, args=(ck, 1, 3, 0),
+                        kwargs={"launch_id": "new"})
+    t.start()
+    try:
+        assert agree_resume_step(ck, 0, 2, 7, timeout=10, poll=0.02,
+                                 launch_id="new") == 3
+    finally:
+        t.cancel()
+    assert not os.path.exists(stale)
+    # Current-launch records survive the cleanup.
+    assert agree_resume_step(ck, 0, 2, 7, timeout=5, poll=0.02,
+                             launch_id="new") == 3
+
+
 def test_heartbeat_path_per_process():
     assert heartbeat_path_for("/x/hb.json", 0) == "/x/hb.json"
     assert heartbeat_path_for("/x/hb.json", 3) == "/x/hb.json.p3"
@@ -440,17 +474,19 @@ def test_collective_hang_wedges_only_the_matching_process():
 
 # --------------------------------------------- gang supervisor (scripted)
 # Same scripted-children trick as the single-process supervisor tests
-# above, but each child logs "<FEDTPU_RESTARTS> <FEDTPU_COORDINATOR>" to
-# its own per-process file so the assertions can read the whole launch
-# matrix (who ran, in which generation, against which coordinator).
+# above, but each child logs "<FEDTPU_RESTARTS> <FEDTPU_COORDINATOR>
+# <FEDTPU_LAUNCH_ID>" to its own per-process file so the assertions can
+# read the whole launch matrix (who ran, in which generation, against
+# which coordinator, under which launch identity).
 def _gang_script(body):
     return ("import os, sys, time\n"
             "log = sys.argv[1]\n"
             "pid = os.environ.get('FEDTPU_PROCESS_ID', '')\n"
             "gen = os.environ['FEDTPU_RESTARTS']\n"
             "coord = os.environ.get('FEDTPU_COORDINATOR', '')\n"
+            "launch = os.environ.get('FEDTPU_LAUNCH_ID', '')\n"
             "open(log + '.p' + (pid or '0'), 'a').write("
-            "gen + ' ' + coord + '\\n')\n"
+            "gen + ' ' + coord + ' ' + launch + '\\n')\n"
             + body)
 
 
@@ -487,6 +523,12 @@ def test_gang_restart_is_all_or_nothing_with_fresh_port(tmp_path):
     ports = [l.split()[1] for l in launches[0]]
     assert ports[0] != ports[1]
     assert [l.split()[1] for l in launches[1]] == ports
+    # Fresh launch id per relaunch, identical across the gang: the
+    # checkpoint-agreement generation that makes a previous launch's
+    # leftover .agreement files unreadable.
+    lids = [l.split()[2] for l in launches[0]]
+    assert lids[0] and lids[1] and lids[0] != lids[1]
+    assert [l.split()[2] for l in launches[1]] == lids
     g = [e for e in events if e["kind"] == "gang_restart"]
     assert len(g) == 1 and g[0]["payload"]["proc"] == 1
     assert g[0]["payload"]["coordinator_died"] is False
@@ -546,9 +588,31 @@ def test_gang_hang_detection_kills_stale_member(tmp_path):
     assert exits and exits[-1]["payload"]["hung"] is True
 
 
+def test_gang_hang_restart_skips_backoff_like_preemption(tmp_path):
+    # A heartbeat-detected hang SIGKILLs the member (rc -9), but the
+    # failure mode is the one the collective watchdog reports as 75:
+    # the last periodic checkpoint is intact, so the relaunch must not
+    # pay crash backoff.
+    t0 = time.time()
+    rc, launches, events = _gang(
+        tmp_path,
+        "if gen == '0':\n"
+        "    time.sleep(60)\n"
+        "time.sleep(0.3)\nsys.exit(0)",
+        max_restarts=1, hang_timeout=1.0, backoff_base=30.0,
+        heartbeat=str(tmp_path / "hb.json"))
+    # A 30 s crash backoff would blow this bound; a hang skips it.
+    assert rc == 0 and len(launches[0]) == 2
+    assert time.time() - t0 < 20
+    g = [e for e in events if e["kind"] == "gang_restart"]
+    assert len(g) == 1 and g[0]["payload"]["hung"] is True
+    assert g[0]["payload"]["backoff_s"] == 0.0
+
+
 def test_gang_of_one_delegates_to_the_single_supervisor(tmp_path):
     rc, launches, events = _gang(tmp_path, "sys.exit(0)", num_processes=1)
-    assert rc == 0 and launches[0] == ["0 "]   # no coordinator env set
+    # No coordinator/launch env set: both trailing fields are empty.
+    assert rc == 0 and launches[0] == ["0  "]
     assert not [e for e in events if e["kind"] == "gang_start"]
 
 
