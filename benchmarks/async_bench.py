@@ -100,6 +100,79 @@ def bench_tick_cost():
     return rows
 
 
+def bench_trace_driven(trace_path):
+    """Tick cost with arrivals sourced from a serving trace file — the
+    driven-step twin of the synthetic-rate rows, so trace-driven and
+    Bernoulli numbers sit side by side in one artifact.
+
+    The trace's virtual timestamps are bucketed onto a (tps, C) 0/1 mask
+    (tick index from the horizon, slot = user % C — the serving engine's
+    bounded-cohort fold) and the compiled driven step replays that mask;
+    same timing harness, same flops floor."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedtpu.config import DataConfig, ModelConfig, OptimConfig, ShardConfig
+    from fedtpu.data import load_dataset
+    from fedtpu.data.sharding import pack_clients
+    from fedtpu.models import build_model
+    from fedtpu.ops import build_optimizer
+    from fedtpu.parallel import async_fed, client_sharding, make_mesh
+    from fedtpu.serving.traces import load_trace_arrays
+    from fedtpu.utils.timing import (assert_above_flops_floor,
+                                     compile_with_flops,
+                                     measured_peak_flops, timed_rounds)
+
+    C, TPS = 8, 100
+    header, t, user, _lat = load_trace_arrays(trace_path)
+    span = max(float(header.horizon_s),
+               float(t[-1]) if len(t) else 1.0)
+    tick = np.minimum((t / span * TPS).astype(np.int64), TPS - 1)
+    masks = np.zeros((TPS, C), np.float32)
+    masks[tick, user.astype(np.int64) % C] = 1.0
+    density = float(masks.mean())
+
+    ds = load_dataset(DataConfig())
+    mesh = make_mesh(num_clients=C)
+    shard = client_sharding(mesh)
+    packed = pack_clients(ds.x_train, ds.y_train, ShardConfig(num_clients=C))
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=ds.input_dim,
+                                                num_classes=ds.num_classes))
+    tx = build_optimizer(OptimConfig())
+    peak = measured_peak_flops(dtype="float32",
+                               device=mesh.devices.ravel()[0])
+
+    state = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                       init_fn, tx)
+    step = async_fed.build_async_round_fn(mesh, apply_fn, tx,
+                                          ds.num_classes,
+                                          ticks_per_step=TPS, driven=True)
+    arrivals = jnp.asarray(masks)
+    compiled, flops = compile_with_flops(step, state, batch, arrivals)
+
+    label = (f"trace-driven tick (tps={TPS}, {header.arrivals} arrivals, "
+             f"slot density {density:.2f})")
+    samples = []
+    for _ in range(3):
+        sec, state, _ = timed_rounds(
+            lambda s, b: compiled(s, b, arrivals), state, batch, 10, TPS,
+            peak, flops, label=label)
+        samples.append(sec)
+    sec = float(np.median(samples))
+    assert_above_flops_floor(sec, flops, peak, label=label)
+    print(f"[async_bench] {label}: {sec:.3e} s/tick "
+          f"(band [{min(samples):.3e}, {max(samples):.3e}])",
+          file=sys.stderr)
+    return [{"row": "tick_cost", "label": label, "sec": sec,
+             "sec_range": [float(min(samples)), float(max(samples))],
+             "flops": flops,
+             "trace": {"path": trace_path, "users": header.users,
+                       "arrivals": header.arrivals,
+                       "slot_density": density}}]
+
+
 def bench_accuracy_vs_arrival():
     from fedtpu.config import RunConfig, get_preset
     from fedtpu.orchestration.loop import run_experiment
@@ -153,8 +226,15 @@ def bench_accuracy_vs_arrival():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
+    ap.add_argument("--trace", default=None,
+                    help="serving trace file (fedtpu.serving.traces "
+                         "JSONL); adds a trace-driven tick_cost row "
+                         "comparable to the synthetic-rate rows")
     args = ap.parse_args()
-    rows = bench_tick_cost() + bench_accuracy_vs_arrival()
+    rows = bench_tick_cost()
+    if args.trace:
+        rows += bench_trace_driven(args.trace)
+    rows += bench_accuracy_vs_arrival()
     out = open(args.json, "w") if args.json else None
     for r in rows:
         line = json.dumps(r, default=float)

@@ -1,0 +1,175 @@
+"""Serving front-end under heavy traffic — the ROADMAP item 5 artifact.
+
+Replays a heavy-tailed synthetic arrival trace (default: 1M simulated
+users, 1M arrivals — Zipf user popularity x lognormal burstiness) through
+the full ingestion path and reports the SLO numbers the serving layer
+exists to measure:
+
+- ``serving_inproc`` row: trace -> admission -> ServingEngine directly
+  (the socket framing removed, everything else identical), the
+  throughput-honest path for millions of arrivals. Reports p50/p99
+  update-to-incorporation latency (VIRTUAL seconds — deterministic),
+  sustained engine rounds/sec under load (WALL — throughput), arrivals
+  ingested/sec, and the admission verdict counts.
+- ``serving_socket`` row: a real ``run_server`` loop (background thread)
+  + the loadgen over localhost TCP with a BOUNDED event count — measures
+  protocol frames/sec and events/sec through the wire, so the socket
+  tax is visible next to the in-process ceiling.
+
+CPU-friendly by design (JAX_PLATFORMS=cpu): the engine cohort is small
+and the model tiny — this benchmark measures the serving machinery, not
+the model math (async_bench.py owns tick FLOP cost).
+
+Usage: JAX_PLATFORMS=cpu python benchmarks/serving_bench.py \
+           [--users 1000000] [--arrivals 1000000] [--json OUT.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def bench_inproc(args):
+    from fedtpu.config import ServingConfig
+    from fedtpu.serving.engine import ServingEngine
+    from fedtpu.serving.traces import synthesize_trace
+
+    header, t, user, lat = synthesize_trace(
+        users=args.users, arrivals=args.arrivals, horizon_s=args.horizon,
+        seed=args.seed)
+    cfg = ServingConfig(cohort=args.cohort, buffer_size=args.buffer_size,
+                        tick_interval_s=args.tick_interval,
+                        flush_every=args.flush_every,
+                        rate_limit=args.rate_limit,
+                        max_pending=args.max_pending)
+    eng = ServingEngine(cfg)
+    # Warm the driven step outside the window (first call compiles).
+    eng.offer(0.0, 0, 0.0)
+    eng.drain()
+    t0 = time.perf_counter()
+    eng.offer_many(zip(user.tolist(), t.tolist(), lat.tolist()))
+    eng.drain()
+    wall = time.perf_counter() - t0
+    s = eng.summary()
+    lat_pct = s["update_to_incorporation"]
+    row = {
+        "row": "serving_inproc",
+        "label": (f"trace {args.users} users / {args.arrivals} arrivals "
+                  f"over {args.horizon}s (cohort={args.cohort}, "
+                  f"M={args.buffer_size})"),
+        "users": args.users,
+        "arrivals": args.arrivals,
+        "horizon_s": args.horizon,
+        "cohort": args.cohort,
+        "buffer_size": args.buffer_size,
+        "ticks": s["ticks"],
+        "incorporated": s["incorporated"],
+        "version": s["version"],
+        "admission": s["admission"],
+        "update_to_incorporation": lat_pct,
+        "wall_s": wall,
+        "rounds_per_sec": s["ticks"] / wall if wall > 0 else 0.0,
+        "arrivals_per_sec": args.arrivals / wall if wall > 0 else 0.0,
+    }
+    print(f"[serving_bench] inproc: {s['ticks']} ticks over "
+          f"{args.arrivals} arrivals in {wall:.1f}s wall "
+          f"({row['rounds_per_sec']:.1f} rounds/s, "
+          f"{row['arrivals_per_sec']:.0f} arrivals/s); "
+          f"update->incorporation p50 {lat_pct['p50_s']:.3f}s "
+          f"p99 {lat_pct['p99_s']:.3f}s (virtual)", file=sys.stderr)
+    return [row]
+
+
+def bench_socket(args):
+    from fedtpu.config import ServingConfig
+    from fedtpu.serving.loadgen import run_loadgen
+    from fedtpu.serving.server import run_server
+    from fedtpu.serving.traces import synthesize_trace, write_trace
+
+    n = min(args.socket_events, args.arrivals)
+    with tempfile.TemporaryDirectory() as d:
+        trace = os.path.join(d, "trace.jsonl")
+        header, t, user, lat = synthesize_trace(
+            users=args.users, arrivals=n, horizon_s=args.horizon,
+            seed=args.seed)
+        write_trace(trace, header, t, user, lat)
+        pf = os.path.join(d, "port")
+        cfg = ServingConfig(buffer_size=args.buffer_size,
+                            cohort=args.cohort,
+                            tick_interval_s=args.tick_interval,
+                            flush_every=args.flush_every)
+        th = threading.Thread(
+            target=run_server,
+            kwargs=dict(cfg=cfg, port_file=pf, once=True, verbose=False))
+        th.start()
+        res = run_loadgen(trace, port_file=pf, batch=args.batch)
+        th.join(timeout=120)
+    row = {
+        "row": "serving_socket",
+        "label": f"localhost socket, {n} events, batch={args.batch}",
+        "events": res["events_sent"],
+        "frames": res["frames"],
+        "batch": args.batch,
+        "admission": res["admission"],
+        "wall_s": res["wall_s"],
+        "events_per_sec": res["events_per_sec"],
+        "server_stats": {k: res["server_stats"][k]
+                         for k in ("ticks", "incorporated", "version")},
+    }
+    print(f"[serving_bench] socket: {res['events_sent']} events in "
+          f"{res['frames']} frames, {res['events_per_sec']:.0f} events/s "
+          f"through the wire", file=sys.stderr)
+    return [row]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--users", type=int, default=1_000_000,
+                    help="simulated user population (default 1M)")
+    ap.add_argument("--arrivals", type=int, default=1_000_000,
+                    help="arrival events in the trace (default 1M)")
+    ap.add_argument("--horizon", type=float, default=60.0,
+                    help="virtual-time horizon in seconds")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cohort", type=int, default=8)
+    ap.add_argument("--buffer-size", type=int, default=4)
+    ap.add_argument("--tick-interval", type=float, default=0.05,
+                    help="virtual seconds per engine tick (default 0.05 "
+                         "=> horizon/0.05 ticks regardless of arrival "
+                         "count)")
+    ap.add_argument("--flush-every", type=int, default=0)
+    ap.add_argument("--rate-limit", type=float, default=0.0)
+    ap.add_argument("--max-pending", type=int, default=0)
+    ap.add_argument("--socket-events", type=int, default=20_000,
+                    help="bounded event count for the socket row "
+                         "(default 20k)")
+    ap.add_argument("--batch", type=int, default=2048,
+                    help="loadgen events per protocol frame")
+    ap.add_argument("--skip-socket", action="store_true",
+                    help="only the in-process row")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    rows = bench_inproc(args)
+    if not args.skip_socket:
+        rows += bench_socket(args)
+    out = open(args.json, "w") if args.json else None
+    for r in rows:
+        line = json.dumps(r, default=float)
+        print(line)
+        if out:
+            out.write(line + "\n")
+    if out:
+        out.close()
+
+
+if __name__ == "__main__":
+    main()

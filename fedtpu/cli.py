@@ -66,6 +66,13 @@ def _nonnegative_float(text: str) -> float:
     return value
 
 
+def _positive_float(text: str) -> float:
+    value = float(text)
+    if value <= 0:
+        raise argparse.ArgumentTypeError(f"must be > 0, got {value}")
+    return value
+
+
 def _open_unit_float(text: str) -> float:
     value = float(text)
     if not 0.0 < value < 1.0:
@@ -692,6 +699,141 @@ def build_parser() -> argparse.ArgumentParser:
     chaos_p.add_argument("--quiet", action="store_true",
                          help="suppress per-scenario progress lines")
 
+    # Serving front-end: a long-running ingestion process feeding the
+    # async FedBuff engine from real (traced) arrivals instead of the
+    # in-graph synthetic draw (fedtpu.serving; docs/serving.md).
+    serve_p = sub.add_parser("serve",
+                             help="trace-driven FL serving front-end: "
+                                  "accept streamed client updates over a "
+                                  "localhost socket, admission-control "
+                                  "them, and drive async FedBuff ticks "
+                                  "(docs/serving.md)")
+    serve_p.add_argument("--host", default="127.0.0.1",
+                         help="bind address (default 127.0.0.1; the "
+                              "protocol is a same-host ingestion socket)")
+    serve_p.add_argument("--port", type=_nonnegative_int, default=0,
+                         help="TCP port (default 0 = ephemeral; pair "
+                              "with --port-file)")
+    serve_p.add_argument("--port-file", default=None, metavar="FILE",
+                         help="write the bound port here once listening "
+                              "(ephemeral-port discovery for loadgen)")
+    serve_p.add_argument("--cohort", type=_positive_int, default=8,
+                         help="concurrent engine slots C; user u maps to "
+                              "slot u %% C (default 8)")
+    serve_p.add_argument("--buffer-size", type=_nonnegative_int, default=0,
+                         help="FedBuff K-buffer M: the global only moves "
+                              "once M updates buffered (<=1 applies every "
+                              "tick; default 0)")
+    serve_p.add_argument("--staleness-power", type=_nonnegative_float,
+                         default=0.5,
+                         help="delta discount (1+s)^-p (default 0.5)")
+    serve_p.add_argument("--tick-interval", type=_nonnegative_float,
+                         default=0.5, metavar="S",
+                         help="virtual seconds between engine ticks "
+                              "(0 disables the timer; default 0.5)")
+    serve_p.add_argument("--flush-every", type=_nonnegative_int, default=0,
+                         help="also fire a tick once this many eligible "
+                              "updates pend (0 = timer only)")
+    serve_p.add_argument("--history-window", type=_nonnegative_int,
+                         default=0, metavar="N",
+                         help="keep only the newest N per-tick history "
+                              "rows (0 = unbounded, the determinism "
+                              "artifact; set for long-running servers)")
+    serve_p.add_argument("--rate-limit", type=_nonnegative_float,
+                         default=0.0,
+                         help="token-bucket admission rate in updates per "
+                              "virtual second (0 = off)")
+    serve_p.add_argument("--rate-burst", type=_positive_float, default=64.0,
+                         help="token-bucket burst capacity (default 64)")
+    serve_p.add_argument("--max-pending", type=_nonnegative_int, default=0,
+                         help="reject_backpressure once this many admitted "
+                              "updates await incorporation (0 = off)")
+    serve_p.add_argument("--stale-deprioritize", type=_nonnegative_int,
+                         default=4,
+                         help="versions behind at which an update is "
+                              "deprioritized (default 4)")
+    serve_p.add_argument("--stale-reject", type=_nonnegative_int,
+                         default=16,
+                         help="versions behind at which an update is "
+                              "rejected (default 16)")
+    serve_p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                         help="drain-time (and periodic) serving "
+                              "checkpoints land here; required for "
+                              "--resume")
+    serve_p.add_argument("--checkpoint-every-ticks", type=_nonnegative_int,
+                         default=0,
+                         help="also checkpoint every N engine ticks "
+                              "(0 = drain-time only)")
+    serve_p.add_argument("--resume", action="store_true",
+                         help="restore serving state (engine + pending "
+                              "queue + history) from --checkpoint-dir")
+    serve_p.add_argument("--history", default=None, metavar="JSONL",
+                         help="write the per-tick metric history here at "
+                              "drain — the bitwise-determinism artifact")
+    serve_p.add_argument("--events", default=None, metavar="JSONL",
+                         help="telemetry events sink (read back by "
+                              "'fedtpu report')")
+    serve_p.add_argument("--heartbeat", default=None, metavar="FILE",
+                         help="liveness heartbeat file for 'fedtpu "
+                              "supervise' hang detection")
+    serve_p.add_argument("--once", action="store_true",
+                         help="exit cleanly (drain + checkpoint) after "
+                              "the first client connection closes — "
+                              "bounded smoke runs")
+    serve_p.add_argument("--seed", type=_nonnegative_int, default=0,
+                         help="engine init / synthetic-shard seed")
+    serve_p.add_argument("--platform", choices=["default", "cpu"],
+                         default="default",
+                         help="force the JAX platform before backend init")
+    serve_p.add_argument("--json", action="store_true",
+                         help="print the drain summary as one JSON line")
+    serve_p.add_argument("--quiet", action="store_true",
+                         help="suppress server status lines")
+
+    # Load generation: replay (or synthesize) an arrival trace against a
+    # running server. jax-free — it can run from any machine beside the
+    # server process.
+    load_p = sub.add_parser("loadgen",
+                            help="replay a heavy-tailed arrival trace "
+                                 "against a running 'fedtpu serve' "
+                                 "(docs/serving.md)")
+    load_p.add_argument("trace", help="arrival-trace JSONL path "
+                                      "(fedtpu.serving.traces schema v1)")
+    load_p.add_argument("--synthesize", action="store_true",
+                        help="first write a fresh synthetic trace to the "
+                             "given path (--users/--arrivals/--horizon/"
+                             "--trace-seed), then replay it")
+    load_p.add_argument("--users", type=_positive_int, default=1000000,
+                        help="simulated user population for --synthesize "
+                             "(default 1e6)")
+    load_p.add_argument("--arrivals", type=_positive_int, default=100000,
+                        help="arrival events for --synthesize "
+                             "(default 1e5)")
+    load_p.add_argument("--horizon", type=_positive_float, default=60.0,
+                        help="virtual-time horizon in seconds for "
+                             "--synthesize (default 60)")
+    load_p.add_argument("--trace-seed", type=_nonnegative_int, default=0,
+                        help="synthesizer seed (default 0)")
+    load_p.add_argument("--host", default="127.0.0.1")
+    load_p.add_argument("--port", type=_nonnegative_int, default=None,
+                        help="server port (or use --port-file)")
+    load_p.add_argument("--port-file", default=None, metavar="FILE",
+                        help="poll this file (written by serve "
+                             "--port-file) for the port")
+    load_p.add_argument("--batch", type=_positive_int, default=1024,
+                        help="arrivals per protocol frame (default 1024)")
+    load_p.add_argument("--max-events", type=_nonnegative_int, default=0,
+                        help="truncate the replay after this many events "
+                             "(0 = whole trace)")
+    load_p.add_argument("--no-drain", action="store_true",
+                        help="skip the final drain+stats round-trip")
+    load_p.add_argument("--timeout", type=_positive_float, default=120.0,
+                        help="socket/port-file timeout in seconds")
+    load_p.add_argument("--json", action="store_true",
+                        help="print the replay summary as one JSON line")
+    load_p.add_argument("--quiet", action="store_true",
+                        help="suppress the human-readable summary")
+
     sub.add_parser("presets", help="list shipped presets")
     return parser
 
@@ -801,6 +943,34 @@ def main(argv=None) -> int:
             print(json.dumps(report, default=float))
         return 0 if report["ok"] else 1
 
+    if args.cmd == "loadgen":
+        # Before the platform pin: the loadgen never imports jax — it can
+        # hammer a server from a machine with no backend at all.
+        from fedtpu.serving.loadgen import run_loadgen
+        from fedtpu.serving.traces import synthesize_trace, write_trace
+        if args.synthesize:
+            header, t, user, lat = synthesize_trace(
+                users=args.users, arrivals=args.arrivals,
+                horizon_s=args.horizon, seed=args.trace_seed)
+            write_trace(args.trace, header, t, user, lat)
+            if not args.quiet:
+                print(f"synthesized {args.arrivals} arrivals / "
+                      f"{args.users} users over {args.horizon}s "
+                      f"-> {args.trace}")
+        summary = run_loadgen(args.trace, host=args.host, port=args.port,
+                              port_file=args.port_file, batch=args.batch,
+                              max_events=args.max_events,
+                              drain=not args.no_drain,
+                              timeout=args.timeout)
+        if args.json:
+            print(json.dumps(summary, default=float))
+        elif not args.quiet:
+            print(f"replayed {summary['events_sent']} events in "
+                  f"{summary['frames']} frames "
+                  f"({summary['events_per_sec']:.0f} ev/s); "
+                  f"admission: {summary['admission']}")
+        return 0
+
     if args.cmd == "run" and getattr(args, "max_restarts", None):
         # Self-supervision shorthand: re-issue this exact run as a
         # supervised child. Stripping the flag is what stops the child
@@ -875,6 +1045,42 @@ def main(argv=None) -> int:
                         "sentinel_available", "recompiles", "ok"):
                 print(f"{key}: {report[key]}")
         return 0 if report["ok"] else 1
+
+    if args.cmd == "serve":
+        # Before _apply_overrides: serve carries its own ServingConfig
+        # flag set, not an experiment preset.
+        from fedtpu.config import ServingConfig
+        from fedtpu.resilience.supervisor import EXIT_PREEMPTED, Preempted
+        from fedtpu.serving.server import run_server
+        scfg = ServingConfig(
+            host=args.host, port=args.port, cohort=args.cohort,
+            buffer_size=args.buffer_size,
+            staleness_power=args.staleness_power,
+            tick_interval_s=args.tick_interval,
+            flush_every=args.flush_every,
+            history_window=args.history_window,
+            rate_limit=args.rate_limit,
+            rate_burst=args.rate_burst, max_pending=args.max_pending,
+            stale_deprioritize=args.stale_deprioritize,
+            stale_reject=args.stale_reject, seed=args.seed)
+        try:
+            summary = run_server(
+                scfg, events=args.events,
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every_ticks=args.checkpoint_every_ticks,
+                port_file=args.port_file, history_path=args.history,
+                heartbeat=args.heartbeat, once=args.once,
+                resume=args.resume, verbose=not args.quiet)
+        except Preempted as p:
+            # SIGTERM drain completed: serving state (engine + pending
+            # queue + history) is checkpointed; the supervisor contract's
+            # "restart me" code, same as run.
+            if args.json:
+                print(json.dumps({"preempted": True, "tick": p.round}))
+            return EXIT_PREEMPTED
+        if args.json:
+            print(json.dumps(summary, default=float))
+        return 0
 
     cfg = _apply_overrides(get_preset(args.preset), args)
 

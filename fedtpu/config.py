@@ -362,6 +362,46 @@ class RunConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """`fedtpu serve` — the trace-driven serving front-end
+    (fedtpu.serving; docs/serving.md).
+
+    A bounded cohort of ``cohort`` engine slots absorbs an unbounded
+    user population (user -> slot ``user % cohort``); admitted updates
+    become DRIVEN async FedBuff ticks. All admission/staleness/latency
+    decisions run on the VIRTUAL clock carried by arrival timestamps,
+    so identical trace + seed replays bitwise-identically."""
+
+    host: str = "127.0.0.1"        # ingestion socket binds localhost only
+    port: int = 0                  # 0 = ephemeral (see --port-file)
+    cohort: int = 8                # concurrent engine slots (C)
+    buffer_size: int = 0           # FedBuff K-buffer M; <= 1 applies per tick
+    staleness_power: float = 0.5   # delta discount (1+s)^-p
+    server_lr: float = 1.0
+    local_steps: int = 1
+    # Tick cadence — both may be active; 0 disables that trigger.
+    tick_interval_s: float = 0.5   # virtual seconds between engine ticks
+    flush_every: int = 0           # fire once this many eligible updates pend
+    # Keep only the newest N per-tick history rows (0 = unbounded). The
+    # history is the bitwise-determinism artifact, so it stays unbounded
+    # by default; a supervised long-running server sets a window so the
+    # row list (and its checkpoint) stops growing one row per tick.
+    history_window: int = 0
+    # Admission knobs (fedtpu.serving.admission; virtual-time units).
+    rate_limit: float = 0.0        # updates/s; 0 = off
+    rate_burst: float = 64.0
+    max_pending: int = 0           # queue-depth backpressure cutoff; 0 = off
+    stale_deprioritize: int = 4    # versions behind => deprioritize
+    stale_reject: int = 16         # versions behind => reject
+    # Cohort training fixture (synthetic income-shaped shards).
+    data_rows: int = 256
+    data_features: int = 6
+    data_classes: int = 2
+    model_hidden: Tuple[int, ...] = (16, 8)
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     data: DataConfig = DataConfig()
     shard: ShardConfig = ShardConfig()

@@ -131,7 +131,8 @@ def build_async_round_fn(mesh, apply_fn: Callable,
                          local_steps: int = 1,
                          prox_mu: float = 0.0,
                          buffer_size: int = 0,
-                         ticks_per_step: int = 1) -> Callable:
+                         ticks_per_step: int = 1,
+                         driven: bool = False) -> Callable:
     """Compile the async server tick. Returns ``step(state, batch) ->
     (state, metrics)`` over client-sharded batches, like the synchronous
     engines; ``metrics`` additionally carries ``staleness`` — the (R, C)
@@ -152,6 +153,18 @@ def build_async_round_fn(mesh, apply_fn: Callable,
     contributions are, by design, NOT in the evaluated/checkpointed
     global until they apply. Requires ``init_async_state(...,
     buffer_size=M)`` so the state carries the buffer keys.
+
+    ``driven=True`` replaces the in-graph Bernoulli arrival draw with an
+    EXTERNALLY SUPPLIED arrival mask: the step becomes ``step(state,
+    batch, arrivals)`` where ``arrivals`` is a ``(ticks_per_step, C)``
+    0/1 float array — tick t trains exactly the clients ``arrivals[t]``
+    marks. This is the serving front-end's ingestion hook
+    (fedtpu.serving): real client arrivals, already through admission
+    control, become the completion process instead of a synthetic rate.
+    ``arrival_rate``/``arrival_seed`` are ignored when driven; every
+    other knob (staleness discounting, server_lr, the K-buffer) applies
+    identically, so trace-driven and synthetic numbers are directly
+    comparable.
     DONATES the input state — rebind, clone to keep."""
     if not 0.0 < arrival_rate <= 1.0:
         raise ValueError(f"arrival_rate must be in (0, 1], got "
@@ -174,18 +187,22 @@ def build_async_round_fn(mesh, apply_fn: Callable,
     n_devices = mesh.devices.size
 
     def tick_body(params, opt_state, anchors, pull, buf, nbuf, x, y, mask,
-                  rnd):
+                  rnd, arrivals):
         cb = x.shape[0]
         gidx = jax.lax.axis_index(CLIENTS_AXIS) * cb + jnp.arange(cb)
 
-        def scan_tick(carry, _):
+        def scan_tick(carry, arr):
             params, opt_state, anchors, pull, buf, nbuf, g, r = carry
 
             def per_client(cond, a, b):
                 return jnp.where(cond.reshape((cb,) + (1,) * (a.ndim - 1)),
                                  a, b)
 
-            if arrival_rate < 1.0:
+            if driven:
+                # The caller's admission layer decided who completes this
+                # tick; `arr` is that (cb,) slice of the arrival mask.
+                arrive = arr.astype(jnp.float32)
+            elif arrival_rate < 1.0:
                 tick_key = jax.random.fold_in(
                     jax.random.key(arrival_seed), r)
                 u = jax.vmap(lambda i: jax.random.uniform(
@@ -267,7 +284,7 @@ def build_async_round_fn(mesh, apply_fn: Callable,
             jax.lax.scan(
                 scan_tick,
                 (params, opt_state, anchors, pull, buf, nbuf, g0, rnd),
-                length=ticks_per_step)
+                arrivals)
         loss, conf, pooled, stale = stacked
         return (params, opt_state, anchors, pull, buf, nbuf, loss, conf,
                 pooled, stale)
@@ -277,13 +294,12 @@ def build_async_round_fn(mesh, apply_fn: Callable,
     sharded = jax.shard_map(
         tick_body, mesh=mesh,
         in_specs=(spec_c, spec_c, spec_c, spec_c, P(), P(), spec_c, spec_c,
-                  spec_c, P()),
+                  spec_c, P(), spec_rc),
         out_specs=(spec_c, spec_c, spec_c, spec_c, P(), P(), spec_rc,
                    spec_rc, P(), spec_rc),
     )
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def step(state, batch):
+    def _run(state, batch, arrivals):
         if buffered and "buf_delta" not in state:
             raise ValueError("buffer_size >= 2 needs a state initialized "
                              "with init_async_state(..., buffer_size=M)")
@@ -299,7 +315,7 @@ def build_async_round_fn(mesh, apply_fn: Callable,
          stale) = sharded(state["params"], state["opt_state"],
                           state["anchors"], state["pull_tick"], buf, nbuf,
                           batch["x"], batch["y"], batch["mask"],
-                          state["round"])
+                          state["round"], arrivals)
         metrics = assemble_metrics(loss, conf, pooled, batch["mask"],
                                    ticks_per_step)
         metrics["staleness"] = (stale if ticks_per_step > 1 else stale[0])
@@ -310,6 +326,22 @@ def build_async_round_fn(mesh, apply_fn: Callable,
             new_state["buf_delta"] = buf
             new_state["buf_count"] = nbuf
         return new_state, metrics
+
+    if driven:
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, batch, arrivals):
+            arrivals = jnp.asarray(arrivals, jnp.float32)
+            return _run(state, batch, arrivals)
+    else:
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, batch):
+            # The scan xs slot exists in both modes; here it is a traced
+            # zero constant the Bernoulli branch never reads, so XLA
+            # folds it away and the compiled program is the pre-driven
+            # one.
+            arrivals = jnp.zeros((ticks_per_step, batch["x"].shape[0]),
+                                 jnp.float32)
+            return _run(state, batch, arrivals)
 
     return step
 

@@ -1,0 +1,37 @@
+"""Trace-driven heavy-traffic FL serving front-end (ROADMAP item 5).
+
+The async FedBuff engine (fedtpu.parallel.async_fed) ticks on a synthetic
+Bernoulli arrival process — fine for studying staleness, useless for
+serving traffic. This package is the real ingestion path around it:
+
+    traces    — versioned JSONL arrival-trace schema, a heavy-tailed
+                synthesizer (Zipf user popularity x lognormal burstiness),
+                and deterministic replay
+    admission — token-bucket rate limiting, staleness-aware
+                accept / deprioritize / reject, queue-depth backpressure
+    protocol  — the newline-delimited-JSON socket protocol `fedtpu serve`
+                speaks (versioned; batch frames for load)
+    engine    — ServingEngine: admitted arrivals map onto a bounded
+                cohort of engine slots and become DRIVEN async ticks
+                (build_async_round_fn(driven=True)); tracks
+                update-to-incorporation latency in trace (virtual) time,
+                so the metric history is bitwise-reproducible
+    server    — the long-running `fedtpu serve` process: socket loop,
+                SIGTERM -> drain -> checkpoint -> exit 75 (the
+                orchestration/loop.py supervisor contract, so
+                `fedtpu supervise -- serve ...` restarts it with the
+                buffer state recoverable)
+    loadgen   — `fedtpu loadgen`: replays an arrival trace against a
+                running server for millions of simulated users
+
+Import-light like fedtpu.telemetry: nothing here imports jax at module
+scope — traces/admission/protocol run backend-free (the loadgen and the
+report side never touch a device), and the engine imports jax lazily at
+construction.
+"""
+
+from fedtpu.serving.admission import (AdmissionController,  # noqa: F401
+                                      TokenBucket, VERDICTS)
+from fedtpu.serving.traces import (TRACE_SCHEMA_VERSION,  # noqa: F401
+                                   read_trace, synthesize_trace,
+                                   write_trace)

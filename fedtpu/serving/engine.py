@@ -1,0 +1,518 @@
+"""ServingEngine: admitted arrivals -> driven FedBuff ticks.
+
+The bridge between the ingestion path (traces / sockets / admission) and
+the in-graph async engine. A bounded COHORT of ``C`` engine slots stands
+in for millions of users — user ``u`` maps to slot ``u % C`` — so engine
+memory is cohort-sized while the arrival stream is unbounded. Admitted
+updates queue per slot; when a tick fires, every slot with an eligible
+queued update "arrives" in that tick's ``(1, C)`` mask and the driven
+step (``build_async_round_fn(driven=True)``) trains exactly those slots.
+Multiple updates queued on one slot coalesce into that one arrival —
+tick count scales with the flush cadence, not the arrival count.
+
+Two clocks, deliberately separate:
+
+- the VIRTUAL clock (trace timestamps) drives everything semantic:
+  admission, tick firing, staleness, and the update-to-incorporation
+  latency (tick virtual time minus arrival ``t``). The per-tick metric
+  history therefore contains only virtual-time numerics and is
+  bitwise-identical across replays of the same trace + seed — the
+  determinism the serving tests and acceptance criteria pin.
+- the WALL clock is only ever used for throughput telemetry
+  (rounds/sec-under-load in the drain summary), never for decisions.
+
+Ticks fire on either cadence (both may be active):
+- time-driven: every ``tick_interval_s`` virtual seconds;
+- count-driven: as soon as ``flush_every`` eligible updates pend.
+
+Deprioritized admissions become eligible one tick LATER than accepted
+ones, so deprioritization is a measurable latency penalty, not a no-op.
+
+Version bookkeeping mirrors the in-graph K-buffer rule exactly on the
+host (arrived-slot counts accumulate; the version bumps when
+``buffer_size`` arrivals have accumulated) — no device fetch on the hot
+path. Staleness of an arriving update is inferred server-side: the
+client pulled at ``t - lat``, so its version is the newest apply at or
+before that time (an explicit ``version`` in the message wins).
+
+jax is imported lazily in ``__init__`` — constructing configs or
+importing this module stays backend-free (loadgen, report tooling).
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from fedtpu.serving.admission import (ADMITTED, DEPRIORITIZE, VERDICTS,
+                                      AdmissionController, AdmissionPolicy)
+from fedtpu.telemetry.metrics import (Histogram, MetricsRegistry,
+                                      default_registry)
+from fedtpu.telemetry.report import _percentiles
+from fedtpu.telemetry.trace import NullTracer
+
+# Prometheus-style `le` upper bounds for update-to-incorporation latency
+# (virtual seconds). Sub-tick to minutes: covers flush cadences from the
+# bench's tight loops to lazy 30 s intervals.
+LATENCY_BINS_S = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                  10.0, 30.0, 60.0)
+
+# History keys, in row order. One value per fired tick; everything is
+# virtual-time-derived, which is what makes the history replayable
+# bitwise (module docstring).
+HISTORY_KEYS = ("tick_t", "tick_updates", "tick_slots", "tick_version",
+                "tick_nbuf", "tick_pending")
+
+# Exact-latency window: summary() percentiles are computed over at most
+# this many most-recent incorporation latencies. The cumulative
+# ``update_to_incorporation`` Histogram keeps the FULL-run distribution;
+# the window only bounds the exact list so a long-running server does
+# not grow one float per incorporated update forever.
+LATENCY_WINDOW = 100_000
+
+# Apply-log compaction bounds: once the (apply time, version) log passes
+# MAX entries it is trimmed to the KEEP newest. Verdict-preserving as
+# long as ``stale_reject < _APPLIES_KEEP`` (see _compact_applies).
+_APPLIES_MAX = 8192
+_APPLIES_KEEP = 4096
+
+
+@dataclass(frozen=True)
+class _Pending:
+    """One admitted, not-yet-incorporated update."""
+
+    t: float            # virtual arrival time
+    user: int
+    elig_tick: int      # first tick index this entry may ride
+
+
+@dataclass
+class EngineClock:
+    """Virtual clock + tick-firing schedule (pure host arithmetic,
+    split out so tests can pin the cadence without a device)."""
+
+    tick_interval_s: float
+    now: float = 0.0
+    next_fire: float = field(init=False)
+
+    def __post_init__(self):
+        self.next_fire = self.tick_interval_s
+
+    def advance(self, t: float) -> None:
+        # Arrival timestamps are sorted (traces.py enforces it); clamping
+        # instead of raising keeps multi-connection servers alive when
+        # two loadgens interleave slightly out of order.
+        self.now = max(self.now, float(t))
+
+    def due(self) -> bool:
+        return self.tick_interval_s > 0 and self.now >= self.next_fire
+
+    def fire_time(self) -> float:
+        """Consume one scheduled firing, returning its virtual time."""
+        t = self.next_fire
+        self.next_fire += self.tick_interval_s
+        return t
+
+
+def _observe_array(hist: Histogram, values: np.ndarray) -> None:
+    """Vectorized ``Histogram.observe_many`` — identical semantics, numpy
+    reductions instead of a per-value Python loop (the hot path sees a
+    tick's whole latency batch at once; 1M-arrival replays would spend
+    seconds in the scalar loop)."""
+    if values.size == 0:
+        return
+    hist.count += int(values.size)
+    hist.sum += float(values.sum())
+    hist.min = min(hist.min, float(values.min()))
+    hist.max = max(hist.max, float(values.max()))
+    for i, b in enumerate(hist.bins):
+        hist.bucket_counts[i] += int((values <= b).sum())
+
+
+class ServingEngine:
+    """Feeds a driven async FedBuff state from admitted arrivals.
+
+    Single-threaded by design, like the round loop — the server's socket
+    loop and the in-process bench both call it from one thread.
+    """
+
+    def __init__(self, cfg, registry: Optional[MetricsRegistry] = None,
+                 tracer=None):
+        """``cfg`` is a :class:`fedtpu.config.ServingConfig`."""
+        import jax
+
+        from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+        from fedtpu.data.sharding import pack_clients
+        from fedtpu.data.tabular import synthetic_income_like
+        from fedtpu.models import build_model
+        from fedtpu.ops import build_optimizer
+        from fedtpu.parallel import async_fed, client_sharding, make_mesh
+
+        self.cfg = cfg
+        self.registry = registry if registry is not None else default_registry()
+        self.tracer = tracer if tracer is not None else NullTracer()
+        self.C = int(cfg.cohort)
+        self.M = int(cfg.buffer_size)
+        self._apply_n = self.M if self.M >= 2 else 1
+
+        self.admission = AdmissionController(
+            AdmissionPolicy(rate_limit=cfg.rate_limit,
+                            rate_burst=cfg.rate_burst,
+                            max_pending=cfg.max_pending,
+                            stale_deprioritize=cfg.stale_deprioritize,
+                            stale_reject=cfg.stale_reject),
+            registry=self.registry)
+        self.clock = EngineClock(tick_interval_s=cfg.tick_interval_s)
+        self.flush_every = int(cfg.flush_every)
+
+        # The cohort's training fixture: synthetic income-shaped shards,
+        # one per slot — serving exercises the ingestion/tick machinery,
+        # not a particular dataset (swap in a real Dataset via run/loop
+        # when that matters).
+        x, y = synthetic_income_like(cfg.data_rows, cfg.data_features,
+                                     cfg.data_classes, seed=cfg.seed)
+        packed = pack_clients(x, y, ShardConfig(num_clients=self.C,
+                                                shuffle=False))
+        init_fn, apply_fn = build_model(ModelConfig(
+            input_dim=cfg.data_features, num_classes=cfg.data_classes,
+            hidden_sizes=tuple(cfg.model_hidden)))
+        tx = build_optimizer(OptimConfig())
+        self.mesh = make_mesh(num_clients=self.C)
+        shard = client_sharding(self.mesh)
+        self.batch = {k: jax.device_put(v, shard) for k, v in
+                      {"x": packed.x, "y": packed.y,
+                       "mask": packed.mask}.items()}
+        self.state = async_fed.init_async_state(
+            jax.random.key(cfg.seed), self.mesh, self.C, init_fn, tx,
+            same_init=True, buffer_size=self.M)
+        self.step = async_fed.build_async_round_fn(
+            self.mesh, apply_fn, tx, cfg.data_classes,
+            staleness_power=cfg.staleness_power, server_lr=cfg.server_lr,
+            local_steps=cfg.local_steps, buffer_size=self.M,
+            ticks_per_step=1, driven=True)
+
+        # Host-side serving state (all of it checkpointed; see
+        # checkpoint()/restore()).
+        self.pending: list[_Pending] = []
+        self.tick_count = 0
+        self.version = 0
+        self.nbuf_host = 0.0
+        self.incorporated = 0
+        # Apply history for server-side staleness inference: parallel
+        # sorted arrays of (virtual apply time, version after the apply).
+        self._applies_t: list[float] = []
+        self._applies_v: list[int] = []
+        self.history: dict = {k: [] for k in HISTORY_KEYS}
+        self.latencies: list[float] = []
+        self._lat_hist = self.registry.histogram("update_to_incorporation",
+                                                 bins=LATENCY_BINS_S)
+        self._wall_start = time.monotonic()
+
+    # ------------------------------------------------------------------
+    # ingestion
+
+    def pulled_version(self, t_pull: float) -> int:
+        """The model version a client that pulled at ``t_pull`` got."""
+        i = bisect.bisect_right(self._applies_t, t_pull)
+        return self._applies_v[i - 1] if i else 0
+
+    def _compact_applies(self) -> None:
+        """Trim the apply log to the ``_APPLIES_KEEP`` newest entries once
+        it passes ``_APPLIES_MAX`` — only recent entries are ever
+        decisive. Verdict-preserving: each log entry bumps the version by
+        one, so a pull older than the kept window is at least
+        ``_APPLIES_KEEP`` versions stale whether looked up in the full
+        log (true pulled version) or the trimmed one (floor of 0); both
+        sides of every ``stale_reject < _APPLIES_KEEP`` bar agree, so
+        replay determinism and the resume contract are untouched. An
+        exotic config with a deeper staleness bar keeps the full log."""
+        if (len(self._applies_t) > _APPLIES_MAX
+                and self.admission.policy.stale_reject < _APPLIES_KEEP):
+            del self._applies_t[:-_APPLIES_KEEP]
+            del self._applies_v[:-_APPLIES_KEEP]
+
+    def offer(self, t: float, user: int, lat: float,
+              version: Optional[int] = None) -> str:
+        """Admit (or not) one arriving update; fires any due ticks first.
+
+        Returns the admission verdict. Admitted updates queue on slot
+        ``user % cohort`` and become eligible at the NEXT tick (one tick
+        later when deprioritized).
+        """
+        self.clock.advance(t)
+        self._fire_due()
+        pulled = (int(version) if version is not None
+                  else self.pulled_version(t - lat))
+        staleness = max(0, self.version - pulled)
+        verdict = self.admission.decide(self.clock.now, staleness,
+                                        len(self.pending))
+        if verdict in ADMITTED:
+            elig = self.tick_count + (2 if verdict == DEPRIORITIZE else 1)
+            self.pending.append(_Pending(t=float(t), user=int(user),
+                                         elig_tick=elig))
+            self.registry.gauge("serve_pending").set(len(self.pending))
+            if self.flush_every and self._eligible_count() >= self.flush_every:
+                self._tick(self.clock.now)
+        return verdict
+
+    def offer_many(self, events) -> dict:
+        """Batch ingestion: ``events`` is an iterable of (user, t, lat)
+        rows (the protocol's ``updates`` frame / trace replay). Returns
+        per-verdict counts for the batch."""
+        counts: dict = {}
+        for user, t, lat in events:
+            v = self.offer(float(t), int(user), float(lat))
+            counts[v] = counts.get(v, 0) + 1
+        return counts
+
+    # ------------------------------------------------------------------
+    # ticking
+
+    def _eligible_count(self, drain: bool = False) -> int:
+        if drain:
+            return len(self.pending)
+        # elig_tick <= tick_count: eligible for the tick about to fire
+        # (tick indices == fired-tick count so far). Entries admitted
+        # after the last firing carry elig_tick == tick_count + 1.
+        return sum(1 for p in self.pending
+                   if p.elig_tick <= self.tick_count + 1)
+
+    def _fire_due(self) -> None:
+        while self.clock.due():
+            self._tick(self.clock.fire_time())
+
+    def _tick(self, t_fire: float, drain: bool = False) -> int:
+        """Fire one engine tick at virtual time ``t_fire``; returns how
+        many pending updates it incorporated (0 skips the device call —
+        an empty tick would train nobody)."""
+        self.tick_count += 1
+        k = self.tick_count
+        ready = [p for p in self.pending
+                 if drain or p.elig_tick <= k]
+        if not ready:
+            self._record_tick(t_fire, 0, 0)
+            return 0
+        self.pending = [p for p in self.pending
+                        if not (drain or p.elig_tick <= k)]
+        slots = sorted({p.user % self.C for p in ready})
+        mask = np.zeros((1, self.C), np.float32)
+        mask[0, slots] = 1.0
+        self.state, _metrics = self.step(self.state, self.batch, mask)
+        # Host mirror of the in-graph K-buffer apply rule: each arriving
+        # SLOT counts one buffered update; the global (and therefore the
+        # version clients pull) moves when apply_n have accumulated.
+        self.nbuf_host += float(len(slots))
+        if self.nbuf_host >= self._apply_n:
+            self.version += 1
+            self.nbuf_host = 0.0
+            self._applies_t.append(t_fire)
+            self._applies_v.append(self.version)
+            self._compact_applies()
+        lats = np.asarray([t_fire - p.t for p in ready], np.float64)
+        _observe_array(self._lat_hist, lats)
+        self.latencies.extend(lats.tolist())
+        if len(self.latencies) > LATENCY_WINDOW:
+            del self.latencies[:len(self.latencies) - LATENCY_WINDOW]
+        self.incorporated += len(ready)
+        self.registry.counter("serve_updates_incorporated").inc(len(ready))
+        self._record_tick(t_fire, len(ready), len(slots))
+        return len(ready)
+
+    def _record_tick(self, t_fire: float, n_updates: int,
+                     n_slots: int) -> None:
+        row = (float(t_fire), int(n_updates), int(n_slots),
+               int(self.version), float(self.nbuf_host),
+               len(self.pending))
+        for key, val in zip(HISTORY_KEYS, row):
+            self.history[key].append(val)
+        win = int(self.cfg.history_window)
+        if win and len(self.history["tick_t"]) > win:
+            cut = len(self.history["tick_t"]) - win
+            for key in HISTORY_KEYS:
+                del self.history[key][:cut]
+        self.registry.counter("serve_ticks").inc()
+        self.registry.gauge("serve_pending").set(len(self.pending))
+        self.registry.gauge("serve_version").set(self.version)
+        self.tracer.event("serve_tick", round=self.tick_count,
+                          t_virtual=float(t_fire), n_updates=n_updates,
+                          n_slots=n_slots, version=self.version,
+                          pending=len(self.pending))
+
+    # ------------------------------------------------------------------
+    # drain / summary / persistence
+
+    def drain(self) -> int:
+        """Incorporate EVERYTHING still pending (eligibility waived) in
+        one final tick, then flag K-buffer starvation if buffered updates
+        never reached an apply — the PR 5 ``async_starvation`` event,
+        here an SLO signal rather than an end-of-run warning. Returns the
+        number of updates the drain tick incorporated."""
+        n = self._tick(self.clock.now, drain=True) if self.pending else 0
+        if self.M >= 2 and self.nbuf_host > 0:
+            self.tracer.event("async_starvation", round=self.tick_count,
+                              pending=int(self.nbuf_host),
+                              buffer_size=self.M)
+            self.registry.counter("async_starvation_events").inc()
+        return n
+
+    def summary(self) -> dict:
+        """Drain-time SLO snapshot; emitted as the ``serve_summary``
+        event and returned to drain/stats protocol callers. Percentiles
+        come from telemetry.report's one implementation, over the most
+        recent :data:`LATENCY_WINDOW` incorporations (None until the
+        first one — stats on an idle server must not crash it).
+        ``wall_s``/``rounds_per_sec`` cover the current launch only;
+        everything else survives checkpoint/restore."""
+        wall = time.monotonic() - self._wall_start
+        out = {
+            "ticks": self.tick_count,
+            "incorporated": self.incorporated,
+            "version": self.version,
+            "pending": len(self.pending),
+            "buffered": float(self.nbuf_host),
+            "admission": dict(self.admission.counts),
+            "update_to_incorporation": (_percentiles(self.latencies)
+                                        if self.latencies else None),
+            "wall_s": wall,
+            "rounds_per_sec": (self.tick_count / wall) if wall > 0 else 0.0,
+        }
+        return out
+
+    def emit_summary(self) -> dict:
+        s = self.summary()
+        self.tracer.event("serve_summary", round=self.tick_count, **s)
+        self.tracer.counters(self.registry.snapshot())
+        return s
+
+    def checkpoint(self, directory: str) -> str:
+        """Persist engine state + serving host state (pending queue,
+        clock, apply log, admission bucket/counts, latency telemetry) +
+        tick history via the standard round checkpoint (orbax), step =
+        tick count. Pending/latency arrays are only attached when
+        nonempty — tensorstore refuses zero-length chunks (same contract
+        as the history filter in save_checkpoint) — and restore treats
+        absence as empty."""
+        from fedtpu.orchestration.checkpoint import save_checkpoint
+        adm = self.admission.state()
+        extra = {
+            "serve_clock": np.float64(self.clock.now),
+            "serve_next_fire": np.float64(self.clock.next_fire),
+            "serve_version": np.int64(self.version),
+            "serve_nbuf": np.float64(self.nbuf_host),
+            "serve_tick_count": np.int64(self.tick_count),
+            "serve_incorporated": np.int64(self.incorporated),
+            # Admission state: without it a resumed token bucket refills
+            # to full burst and the post-resume verdict sequence diverges
+            # from an uninterrupted run whenever rate_limit > 0.
+            "serve_bucket_tokens": np.float64(adm["bucket_tokens"]),
+            "serve_bucket_t": np.float64(adm["bucket_t"]),
+            "serve_admission_counts": np.asarray(adm["counts"], np.int64),
+            # Latency telemetry: the cumulative histogram state (count,
+            # sum, min, max + per-bucket counts) so post-resume summaries
+            # and Prometheus exports cover the whole run.
+            "serve_lat_hist": np.asarray(
+                [self._lat_hist.count, self._lat_hist.sum,
+                 self._lat_hist.min, self._lat_hist.max], np.float64),
+            "serve_lat_buckets": np.asarray(self._lat_hist.bucket_counts,
+                                            np.int64),
+        }
+        if self.latencies:
+            extra["serve_latencies"] = np.asarray(self.latencies,
+                                                  np.float64)
+        if self.pending:
+            extra["pend_t"] = np.asarray([p.t for p in self.pending])
+            extra["pend_user"] = np.asarray([p.user for p in self.pending],
+                                            np.int64)
+            extra["pend_elig"] = np.asarray(
+                [p.elig_tick for p in self.pending], np.int64)
+        if self._applies_t:
+            extra["applies_t"] = np.asarray(self._applies_t)
+            extra["applies_v"] = np.asarray(self._applies_v, np.int64)
+        return save_checkpoint(directory, self.state, self.history,
+                               self.tick_count, extra_meta=extra)
+
+    def restore(self, directory: str) -> int:
+        """Restore engine + serving host state from the newest checkpoint
+        under ``directory`` (written by :meth:`checkpoint`). Returns the
+        restored tick count."""
+        from fedtpu.orchestration.checkpoint import (load_checkpoint,
+                                                     load_meta)
+        state, history, step = load_checkpoint(directory,
+                                               state_like=self.state)
+        meta = load_meta(directory)
+        self.state = state
+        # Checkpointed history comes back as numpy scalars; .item() them
+        # so resumed history rows serialize byte-identically to fresh ones.
+        self.history = {k: [v.item() if hasattr(v, "item") else v
+                            for v in history.get(k, [])]
+                        for k in HISTORY_KEYS}
+        self.tick_count = int(np.asarray(meta["serve_tick_count"]))
+        self.version = int(np.asarray(meta["serve_version"]))
+        self.nbuf_host = float(np.asarray(meta["serve_nbuf"]))
+        self.incorporated = int(np.asarray(meta["serve_incorporated"]))
+        self.clock.now = float(np.asarray(meta["serve_clock"]))
+        self.clock.next_fire = float(np.asarray(meta["serve_next_fire"]))
+        self._applies_t = [float(v) for v in
+                           np.atleast_1d(meta.get("applies_t", []))]
+        self._applies_v = [int(v) for v in
+                           np.atleast_1d(meta.get("applies_v", []))]
+        # Admission + latency state (absent in checkpoints written before
+        # these keys existed — such resumes keep the fresh-start
+        # defaults, the old behavior).
+        if meta.get("serve_bucket_tokens") is not None:
+            self.admission.restore_state(
+                float(np.asarray(meta["serve_bucket_tokens"])),
+                float(np.asarray(meta["serve_bucket_t"])),
+                [int(v) for v in
+                 np.atleast_1d(meta["serve_admission_counts"])])
+        self.latencies = [float(v) for v in
+                          np.atleast_1d(meta.get("serve_latencies", []))]
+        if meta.get("serve_lat_hist") is not None:
+            stats = np.atleast_1d(meta["serve_lat_hist"])
+            h = self._lat_hist
+            h.count = int(stats[0])
+            h.sum = float(stats[1])
+            if h.count:
+                h.min = float(stats[2])
+                h.max = float(stats[3])
+            h.bucket_counts = [int(v) for v in
+                               np.atleast_1d(meta["serve_lat_buckets"])]
+        self.pending = []
+        if meta.get("pend_t") is not None:
+            for t, u, e in zip(np.atleast_1d(meta["pend_t"]),
+                               np.atleast_1d(meta["pend_user"]),
+                               np.atleast_1d(meta["pend_elig"])):
+                self.pending.append(_Pending(t=float(t), user=int(u),
+                                             elig_tick=int(e)))
+        # Re-seed the run-total registry instruments so a post-resume
+        # counters snapshot reports the whole run, not the segment.
+        if self.tick_count:
+            self.registry.counter("serve_ticks").inc(self.tick_count)
+        if self.incorporated:
+            self.registry.counter("serve_updates_incorporated").inc(
+                self.incorporated)
+        self.registry.gauge("serve_version").set(self.version)
+        self.registry.gauge("serve_pending").set(len(self.pending))
+        return step
+
+    def history_lines(self) -> list:
+        """The per-tick metric history as canonical JSON lines — the
+        bitwise-determinism artifact (same trace + seed => identical
+        bytes across runs)."""
+        import json
+        rows = []
+        n = len(self.history["tick_t"])
+        for i in range(n):
+            rows.append(json.dumps(
+                {k: self.history[k][i] for k in HISTORY_KEYS},
+                sort_keys=True, separators=(",", ":")))
+        return rows
+
+    def write_history(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            for line in self.history_lines():
+                fh.write(line + "\n")

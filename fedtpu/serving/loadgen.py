@@ -1,0 +1,110 @@
+"""`fedtpu loadgen` — replay an arrival trace against a running server.
+
+Streams a JSONL trace (fedtpu.serving.traces) through the socket
+protocol in batch frames, aggregates the per-verdict admission counts
+the server acks back, and optionally issues a final ``drain`` +
+``stats`` so the run ends with everything incorporated and a full SLO
+snapshot in hand.
+
+Replay is as-fast-as-possible by design: arrival TIMESTAMPS carry the
+virtual clock, so the server's admission/staleness/latency behavior is
+identical whether the trace is streamed in one burst or paced over an
+hour — wall time only changes the throughput numbers. That is what lets
+one process push millions of simulated users through a localhost socket
+in seconds.
+
+Backend-free: numpy + stdlib only (the loadgen never touches jax).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from fedtpu.serving.protocol import MAX_BATCH_EVENTS, Connection
+from fedtpu.serving.traces import read_trace
+
+
+def read_port_file(path: str, timeout: float = 30.0) -> int:
+    """Poll ``path`` (written by the server once bound) for the port —
+    ephemeral-port discovery when the server was started with port 0."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as fh:
+                txt = fh.read().strip()
+            if txt:
+                return int(txt)
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    raise TimeoutError(f"no port appeared in {path} within {timeout}s")
+
+
+def run_loadgen(trace_path: str, host: str = "127.0.0.1",
+                port: Optional[int] = None,
+                port_file: Optional[str] = None,
+                batch: int = 1024, max_events: int = 0,
+                drain: bool = True, timeout: float = 120.0) -> dict:
+    """Replay ``trace_path`` against the server at ``host:port`` (or the
+    port in ``port_file``). Returns a summary dict: events sent, frames,
+    aggregated admission counts, wall seconds, events/sec, and — when
+    ``drain`` — the server's post-drain stats snapshot.
+
+    ``batch`` events ride per protocol frame (capped at the protocol's
+    MAX_BATCH_EVENTS); ``max_events > 0`` truncates the replay (bounded
+    smoke tests over big traces).
+    """
+    if port is None:
+        if not port_file:
+            raise ValueError("need port or port_file")
+        port = read_port_file(port_file, timeout=timeout)
+    batch = max(1, min(int(batch), MAX_BATCH_EVENTS))
+    header, events = read_trace(trace_path)
+
+    counts: dict = {}
+    sent = frames = 0
+    t0 = time.monotonic()
+    with Connection(host, port, timeout=timeout) as conn:
+        welcome = conn.hello()
+        pending: list = []
+
+        def _flush():
+            nonlocal sent, frames
+            if not pending:
+                return
+            resp = conn.request({"op": "updates", "events": pending})
+            if resp.get("op") != "acks":
+                raise ConnectionError(f"server refused batch: {resp}")
+            for verdict, n in (resp.get("counts") or {}).items():
+                counts[verdict] = counts.get(verdict, 0) + int(n)
+            sent += len(pending)
+            frames += 1
+            pending.clear()
+
+        for ev in events:
+            pending.append([ev.user, ev.t, ev.lat])
+            if len(pending) >= batch:
+                _flush()
+            if max_events and sent + len(pending) >= max_events:
+                break
+        _flush()
+        stats = None
+        if drain:
+            conn.request({"op": "drain"})
+            stats = conn.request({"op": "stats"})
+            stats.pop("op", None)
+    wall = time.monotonic() - t0
+    return {
+        "trace": trace_path,
+        "trace_users": header.users,
+        "trace_arrivals": header.arrivals,
+        "events_sent": sent,
+        "frames": frames,
+        "batch": batch,
+        "cohort": welcome.get("cohort"),
+        "admission": counts,
+        "wall_s": wall,
+        "events_per_sec": (sent / wall) if wall > 0 else 0.0,
+        "server_stats": stats,
+    }

@@ -1,0 +1,130 @@
+"""The `fedtpu serve` wire protocol: newline-delimited JSON over TCP.
+
+One JSON object per line, ``PROTOCOL_VERSION = 1``. The server binds
+localhost only — this is a same-host ingestion socket (the loadgen, a
+sidecar, a gateway), not an internet-facing API.
+
+Client -> server ops:
+
+    {"op": "hello", "v": 1}
+        -> {"op": "welcome", "v": 1, "cohort": C, "version": n}
+    {"op": "update", "user": 123, "t": 1.5, "lat": 0.2[, "version": 7]}
+        -> {"op": "ack", "verdict": "accept", "version": n}
+    {"op": "updates", "events": [[user, t, lat], ...]}
+        -> {"op": "acks", "n": len, "counts": {verdict: n}, "version": n,
+            "tick": k}
+    {"op": "stats"}
+        -> {"op": "stats", ...engine/admission snapshot...}
+    {"op": "drain"}
+        -> {"op": "drained", "tick": k, "incorporated": n}
+
+``t`` is the arrival's virtual-clock timestamp and ``lat`` the client's
+train+upload latency (see traces.py); ``version``, when present, is the
+model version the client claims to have pulled — otherwise the server
+infers it from ``t - lat`` against its own apply history. The batch
+``updates`` frame exists purely for load: one syscall + one parse per
+thousands of arrivals is what lets the loadgen replay millions of
+simulated users through a single socket.
+
+Anything unparseable or unknown gets ``{"op": "error", ...}`` and the
+connection stays up — a load generator mid-replay should not lose its
+socket to one malformed frame.
+
+Framing helpers below are shared by server and loadgen; stdlib only.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Iterator, Optional
+
+PROTOCOL_VERSION = 1
+
+# Batch frames bigger than this are refused (protocol error, connection
+# survives): bounds per-frame memory on the server regardless of client.
+MAX_BATCH_EVENTS = 65536
+
+# A line longer than this is a protocol violation (connection dropped) —
+# prevents one bad client growing the recv buffer without bound.
+MAX_LINE_BYTES = 8 * 1024 * 1024
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    sock.sendall(json.dumps(obj, separators=(",", ":")).encode() + b"\n")
+
+
+def recv_lines(sock: socket.socket, buf: bytearray) -> Iterator[bytes]:
+    """Yield complete lines accumulated in ``buf`` from one recv().
+
+    Returns without yielding when no full line arrived yet; raises
+    ``ConnectionError`` on EOF or an oversized line. ``buf`` carries the
+    partial tail between calls.
+    """
+    chunk = sock.recv(1 << 16)
+    if not chunk:
+        raise ConnectionError("peer closed")
+    buf += chunk
+    if len(buf) > MAX_LINE_BYTES and b"\n" not in buf:
+        raise ConnectionError("line exceeds MAX_LINE_BYTES")
+    while True:
+        nl = buf.find(b"\n")
+        if nl < 0:
+            return
+        line = bytes(buf[:nl])
+        del buf[:nl + 1]
+        if line:
+            yield line
+
+
+def parse_msg(line: bytes) -> Optional[dict]:
+    """Parse one frame; None (not an exception) for malformed input so
+    the server can answer with an ``error`` op instead of dropping."""
+    try:
+        obj = json.loads(line)
+    except ValueError:
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+def error_msg(reason: str) -> dict:
+    return {"op": "error", "v": PROTOCOL_VERSION, "reason": reason}
+
+
+class Connection:
+    """Blocking request/response client used by loadgen and tests."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = bytearray()
+        self._pending: list[bytes] = []
+
+    def request(self, obj: dict) -> dict:
+        send_msg(self.sock, obj)
+        return self.recv()
+
+    def recv(self) -> dict:
+        while not self._pending:
+            self._pending.extend(recv_lines(self.sock, self._buf))
+        msg = parse_msg(self._pending.pop(0))
+        if msg is None:
+            raise ConnectionError("malformed frame from server")
+        return msg
+
+    def hello(self) -> dict:
+        resp = self.request({"op": "hello", "v": PROTOCOL_VERSION})
+        if resp.get("op") != "welcome":
+            raise ConnectionError(f"handshake refused: {resp}")
+        return resp
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "Connection":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -1,0 +1,255 @@
+"""The long-running `fedtpu serve` process.
+
+A single-threaded selectors loop over one localhost listening socket:
+clients (the loadgen, a gateway sidecar) stream update notifications in
+the newline-JSON protocol (fedtpu.serving.protocol), each one passes
+admission, and admitted ones become driven engine ticks
+(fedtpu.serving.engine). Single-threaded is a feature — the engine's
+determinism contract (same trace => same history, bitwise) needs a total
+order over arrivals, and one thread is the cheapest total order.
+
+Lifecycle honors the supervisor contract from orchestration/loop.py:
+
+    SIGTERM/SIGINT -> finish the in-flight frame -> drain (incorporate
+    everything pending) -> checkpoint (engine + serving host state +
+    tick history) -> emit 'preempted' -> raise Preempted -> the CLI
+    exits EXIT_PREEMPTED (75)
+
+so ``fedtpu supervise -- serve --checkpoint-dir D ...`` restarts it with
+``--resume`` and the buffer state RECOVERABLE rather than dropped. The
+heartbeat file (``--heartbeat``) is rewritten on every loop wakeup, so
+the supervisor's hang detection covers the socket loop too.
+
+jax is only touched through the engine; this module stays importable
+backend-free.
+"""
+
+from __future__ import annotations
+
+import os
+import selectors
+import signal
+import socket
+import threading
+from typing import Optional
+
+from fedtpu.serving import protocol
+from fedtpu.serving.engine import ServingEngine
+from fedtpu.telemetry.log import TelemetryLogger
+from fedtpu.telemetry.metrics import default_registry
+
+# Seconds between selector wakeups when idle — bounds signal/heartbeat
+# latency, not throughput (a busy socket wakes the loop immediately).
+_POLL_S = 0.2
+
+# Per-socket timeout on client connections. send_msg blocks in sendall
+# on the single-threaded loop, so a peer that stops reading while we
+# hold a response would wedge ingestion for every connection; the
+# timeout turns it into a dropped connection instead (socket.timeout is
+# an OSError, handled by the per-connection except below).
+_CONN_TIMEOUT_S = 30.0
+
+
+class _Conn:
+    """Per-connection recv buffer."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = bytearray()
+
+
+def _handle(engine: ServingEngine, msg: dict) -> dict:
+    """One request -> one response. Unknown/malformed ops answer with an
+    ``error`` frame instead of dropping the connection — a loadgen
+    mid-replay must not lose its socket to one bad frame."""
+    op = msg.get("op")
+    if op == "hello":
+        v = msg.get("v")
+        if v != protocol.PROTOCOL_VERSION:
+            return protocol.error_msg(
+                f"protocol v={v} unsupported (server speaks "
+                f"v={protocol.PROTOCOL_VERSION})")
+        return {"op": "welcome", "v": protocol.PROTOCOL_VERSION,
+                "cohort": engine.C, "version": engine.version}
+    if op == "update":
+        try:
+            verdict = engine.offer(float(msg["t"]), int(msg["user"]),
+                                   float(msg.get("lat", 0.0)),
+                                   version=msg.get("version"))
+        except (KeyError, TypeError, ValueError) as e:
+            return protocol.error_msg(f"bad update frame: {e}")
+        return {"op": "ack", "verdict": verdict, "version": engine.version}
+    if op == "updates":
+        events = msg.get("events")
+        if not isinstance(events, list):
+            return protocol.error_msg("updates frame needs an events list")
+        if len(events) > protocol.MAX_BATCH_EVENTS:
+            return protocol.error_msg(
+                f"batch of {len(events)} exceeds "
+                f"MAX_BATCH_EVENTS={protocol.MAX_BATCH_EVENTS}")
+        try:
+            counts = engine.offer_many(events)
+        except (TypeError, ValueError, IndexError) as e:
+            return protocol.error_msg(f"bad events row: {e}")
+        return {"op": "acks", "n": len(events), "counts": counts,
+                "version": engine.version, "tick": engine.tick_count}
+    if op == "stats":
+        return {"op": "stats", **engine.summary()}
+    if op == "drain":
+        n = engine.drain()
+        return {"op": "drained", "tick": engine.tick_count,
+                "incorporated": engine.incorporated, "drained": n}
+    return protocol.error_msg(f"unknown op {op!r}")
+
+
+def _safe_handle(engine: ServingEngine, msg: Optional[dict], tracer,
+                 registry) -> dict:
+    """:func:`_handle` behind a crash barrier: an unexpected exception
+    becomes an ``error`` frame (counted as ``serve_handler_errors`` and
+    traced) instead of escaping the single-threaded loop and killing the
+    whole server for every connection. ``Preempted``/KeyboardInterrupt
+    are BaseException and pass through untouched."""
+    try:
+        return (_handle(engine, msg) if msg is not None
+                else protocol.error_msg("malformed frame"))
+    except Exception as e:
+        op = msg.get("op") if isinstance(msg, dict) else None
+        registry.counter("serve_handler_errors").inc()
+        tracer.event("serve_handler_error", op=op,
+                     error=f"{type(e).__name__}: {e}")
+        return protocol.error_msg(
+            f"internal error handling {op!r}: {type(e).__name__}: {e}")
+
+
+def run_server(cfg, *, events: Optional[str] = None,
+               checkpoint_dir: Optional[str] = None,
+               checkpoint_every_ticks: int = 0,
+               port_file: Optional[str] = None,
+               history_path: Optional[str] = None,
+               heartbeat: Optional[str] = None,
+               once: bool = False, resume: bool = False,
+               verbose: bool = True) -> dict:
+    """Serve until SIGTERM (raises ``Preempted`` after the drain) or,
+    with ``once=True``, until the first accepted connection closes
+    (clean drain, returns the summary). ``cfg`` is a ServingConfig.
+
+    ``port_file``: the bound port is written here once listening —
+    ephemeral-port discovery for loadgen/tests. ``checkpoint_every_ticks``
+    adds periodic checkpoints on top of the drain-time one.
+    """
+    from fedtpu.resilience.supervisor import Preempted, write_heartbeat
+    from fedtpu.telemetry import make_tracer
+
+    registry = default_registry()
+    registry.reset()
+    tracer = make_tracer(events)
+    log = TelemetryLogger(verbose=verbose, tracer=tracer)
+    engine = ServingEngine(cfg, registry=registry, tracer=tracer)
+    if resume and checkpoint_dir:
+        from fedtpu.orchestration.checkpoint import latest_step
+        if latest_step(checkpoint_dir) is not None:
+            step = engine.restore(checkpoint_dir)
+            if verbose:
+                log.info(f"resumed serving state at tick {step} "
+                         f"(version {engine.version}, "
+                         f"{len(engine.pending)} pending)")
+
+    # SIGTERM -> drain flag, main thread only (signal.signal's rule);
+    # elsewhere (tests driving run_server from a worker thread) external
+    # stop is simply not intercepted, like the round loop.
+    preempt = {"sig": None}
+    restore_sig = []
+    if threading.current_thread() is threading.main_thread():
+        def _on_sig(signum, frame):
+            preempt["sig"] = signum
+        for s in (signal.SIGTERM, signal.SIGINT):
+            restore_sig.append((s, signal.signal(s, _on_sig)))
+
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind((cfg.host, cfg.port))
+    lsock.listen(16)
+    lsock.setblocking(False)
+    port = lsock.getsockname()[1]
+    if port_file:
+        tmp = f"{port_file}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write(str(port))
+        os.replace(tmp, port_file)
+    if verbose:
+        log.info(f"serving on {cfg.host}:{port} (cohort={cfg.cohort}, "
+                 f"buffer_size={cfg.buffer_size}, once={once})")
+    tracer.event("serve_start", port=port, cohort=cfg.cohort,
+                 buffer_size=cfg.buffer_size, resume=bool(resume))
+
+    sel = selectors.DefaultSelector()
+    sel.register(lsock, selectors.EVENT_READ, None)
+    ever_connected = False
+    last_ckpt_tick = engine.tick_count
+
+    def _shutdown(reason: str) -> dict:
+        engine.drain()
+        summary = engine.emit_summary()
+        if history_path:
+            engine.write_history(history_path)
+        if checkpoint_dir:
+            engine.checkpoint(checkpoint_dir)
+        tracer.event("serve_stop", round=engine.tick_count, reason=reason)
+        if reason == "preempted":
+            tracer.event("preempted", round=engine.tick_count)
+            registry.counter("preemptions").inc()
+        tracer.counters(registry.snapshot())
+        if heartbeat:
+            write_heartbeat(heartbeat, status=reason,
+                            tick=engine.tick_count)
+        tracer.close()
+        return summary
+
+    try:
+        while True:
+            if preempt["sig"] is not None:
+                if verbose:
+                    log.warning(f"signal {preempt['sig']}: draining "
+                                f"{len(engine.pending)} pending update(s) "
+                                "to checkpoint; exiting for resume "
+                                "(preempted).")
+                _shutdown("preempted")
+                raise Preempted(engine.tick_count)
+            if heartbeat:
+                write_heartbeat(heartbeat, status="serving",
+                                tick=engine.tick_count)
+            for key, _ in sel.select(timeout=_POLL_S):
+                if key.data is None:
+                    try:
+                        csock, addr = lsock.accept()
+                    except OSError:
+                        continue
+                    # Timeout mode, not plain blocking: see _CONN_TIMEOUT_S.
+                    # recv never waits on it — the selector already said
+                    # readable — so only a stalled send can trip it.
+                    csock.settimeout(_CONN_TIMEOUT_S)
+                    sel.register(csock, selectors.EVENT_READ, _Conn(csock))
+                    ever_connected = True
+                    tracer.event("serve_accept", peer=str(addr))
+                    continue
+                conn = key.data
+                try:
+                    for line in protocol.recv_lines(conn.sock, conn.buf):
+                        msg = protocol.parse_msg(line)
+                        resp = _safe_handle(engine, msg, tracer, registry)
+                        protocol.send_msg(conn.sock, resp)
+                except (ConnectionError, OSError):
+                    sel.unregister(conn.sock)
+                    conn.sock.close()
+                    if once and ever_connected:
+                        return _shutdown("once")
+            if (checkpoint_dir and checkpoint_every_ticks
+                    and engine.tick_count - last_ckpt_tick
+                    >= checkpoint_every_ticks):
+                engine.checkpoint(checkpoint_dir)
+                last_ckpt_tick = engine.tick_count
+    finally:
+        for s, h in restore_sig:
+            signal.signal(s, h)
+        sel.close()
+        lsock.close()

@@ -1,0 +1,211 @@
+"""Versioned JSONL arrival traces: schema, heavy-tailed synthesis, replay.
+
+A trace file is newline-delimited JSON. Line 1 is a header::
+
+    {"kind": "trace_header", "v": 1, "users": 1000000, "arrivals": 50000,
+     "seed": 0, "horizon_s": 60.0, "generator": "zipf_lognormal",
+     "params": {...}}
+
+Every subsequent line is one client-update arrival, sorted by ``t``::
+
+    {"kind": "arrival", "t": 0.0123, "user": 48713, "lat": 0.87}
+
+``t`` is the arrival time (seconds since trace start, virtual clock) at
+which the update *reaches the server*; ``lat`` is the client's local
+train+upload latency, so the model version the client pulled is the one
+the server had at ``t - lat``. Admission and the serving engine run on
+this virtual clock, which is what makes replay deterministic: identical
+trace + seed => bitwise-identical metric history, independent of wall
+time.
+
+The synthesizer is deliberately heavy-tailed in both dimensions that
+matter for admission control: per-user activity is Zipf-distributed
+(a few hot users dominate, exercising the rate limiter) and both
+inter-arrival gaps and client latencies are lognormal (bursts and
+stragglers, exercising backpressure and staleness cutoffs).
+
+No jax anywhere in this module — numpy + stdlib only, same convention
+as telemetry/report.py, so loadgen and offline tooling never touch a
+device.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import IO, Iterator
+
+import numpy as np
+
+TRACE_SCHEMA_VERSION = 1
+
+
+@dataclass(frozen=True)
+class TraceHeader:
+    """Parsed header line of a trace file."""
+
+    v: int
+    users: int
+    arrivals: int
+    seed: int
+    horizon_s: float
+    generator: str = "unknown"
+    params: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "kind": "trace_header",
+            "v": self.v,
+            "users": self.users,
+            "arrivals": self.arrivals,
+            "seed": self.seed,
+            "horizon_s": self.horizon_s,
+            "generator": self.generator,
+            "params": self.params,
+        }
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One client-update arrival. ``t`` >= ``lat`` >= 0; ``t`` is ascending."""
+
+    t: float
+    user: int
+    lat: float
+
+
+def synthesize_trace(users: int,
+                     arrivals: int,
+                     horizon_s: float = 60.0,
+                     seed: int = 0,
+                     zipf_a: float = 1.2,
+                     gap_sigma: float = 1.0,
+                     lat_mean_s: float = 0.5,
+                     lat_sigma: float = 0.75) -> tuple[TraceHeader, np.ndarray, np.ndarray, np.ndarray]:
+    """Draw a heavy-tailed arrival trace; fully vectorized, one RNG.
+
+    Returns ``(header, t, user, lat)`` as numpy arrays sorted by ``t``.
+
+    - user ids ~ Zipf(zipf_a) folded into [0, users): heavy-tailed
+      per-user activity (hot users hammer the token bucket).
+    - inter-arrival gaps ~ lognormal(0, gap_sigma), normalized so the
+      last arrival lands at ``horizon_s`` (bursty but bounded horizon).
+    - client latency ~ lognormal around ``lat_mean_s`` (stragglers pull
+      stale versions; the tail drives reject_stale).
+    """
+    if users < 1 or arrivals < 1:
+        raise ValueError("users and arrivals must be >= 1")
+    rng = np.random.default_rng(seed)
+    # Zipf draws are unbounded above; fold into the user range. (z - 1)
+    # keeps user 0 the hottest.
+    user = (rng.zipf(zipf_a, size=arrivals) - 1) % users
+    gaps = rng.lognormal(mean=0.0, sigma=gap_sigma, size=arrivals)
+    t = np.cumsum(gaps)
+    t = t * (horizon_s / float(t[-1]))
+    mu = math.log(max(lat_mean_s, 1e-9)) - 0.5 * lat_sigma * lat_sigma
+    lat = rng.lognormal(mean=mu, sigma=lat_sigma, size=arrivals)
+    # A client cannot have pulled before the trace started.
+    lat = np.minimum(lat, t)
+    header = TraceHeader(
+        v=TRACE_SCHEMA_VERSION,
+        users=int(users),
+        arrivals=int(arrivals),
+        seed=int(seed),
+        horizon_s=float(horizon_s),
+        generator="zipf_lognormal",
+        params={
+            "zipf_a": zipf_a,
+            "gap_sigma": gap_sigma,
+            "lat_mean_s": lat_mean_s,
+            "lat_sigma": lat_sigma,
+        },
+    )
+    return header, t, user.astype(np.int64), lat
+
+
+def write_trace(path: str, header: TraceHeader, t: np.ndarray,
+                user: np.ndarray, lat: np.ndarray) -> None:
+    """Write a trace file (header + one arrival line per event)."""
+    if not (len(t) == len(user) == len(lat) == header.arrivals):
+        raise ValueError("header.arrivals does not match array lengths")
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(header.to_json(), sort_keys=True) + "\n")
+        for i in range(len(t)):
+            fh.write('{"kind": "arrival", "t": %.9f, "user": %d, "lat": %.9f}\n'
+                     % (float(t[i]), int(user[i]), float(lat[i])))
+
+
+def read_header(fh: IO[str]) -> TraceHeader:
+    line = fh.readline()
+    if not line:
+        raise ValueError("empty trace file")
+    obj = json.loads(line)
+    if obj.get("kind") != "trace_header":
+        raise ValueError("trace file does not start with a trace_header line")
+    v = int(obj.get("v", -1))
+    if v != TRACE_SCHEMA_VERSION:
+        raise ValueError(f"unsupported trace schema v={v} "
+                         f"(this build reads v={TRACE_SCHEMA_VERSION})")
+    return TraceHeader(
+        v=v,
+        users=int(obj["users"]),
+        arrivals=int(obj["arrivals"]),
+        seed=int(obj.get("seed", 0)),
+        horizon_s=float(obj.get("horizon_s", 0.0)),
+        generator=str(obj.get("generator", "unknown")),
+        params=dict(obj.get("params", {})),
+    )
+
+
+def read_trace(path: str) -> tuple[TraceHeader, Iterator[Arrival]]:
+    """Open a trace for streaming replay.
+
+    Returns the parsed header and a generator of :class:`Arrival` in
+    file order (ascending ``t``). Streaming — a 1M-user trace is never
+    fully materialized by the reader; the caller decides how much to
+    buffer.
+    """
+    fh = open(path, "r", encoding="utf-8")
+    header = read_header(fh)
+
+    def _iter() -> Iterator[Arrival]:
+        last_t = -math.inf
+        try:
+            for line in fh:
+                if not line.strip():
+                    continue
+                obj = json.loads(line)
+                if obj.get("kind") != "arrival":
+                    continue
+                t = float(obj["t"])
+                if t < last_t:
+                    raise ValueError("trace arrivals are not sorted by t")
+                last_t = t
+                yield Arrival(t=t, user=int(obj["user"]),
+                              lat=float(obj.get("lat", 0.0)))
+        finally:
+            fh.close()
+
+    return header, _iter()
+
+
+def load_trace_arrays(path: str) -> tuple[TraceHeader, np.ndarray, np.ndarray, np.ndarray]:
+    """Read a whole trace into ``(header, t, user, lat)`` numpy arrays.
+
+    Convenience for benches and the in-process replay path; prefer
+    :func:`read_trace` when the trace may be huge relative to memory.
+    """
+    header, events = read_trace(path)
+    t = np.empty(header.arrivals, dtype=np.float64)
+    user = np.empty(header.arrivals, dtype=np.int64)
+    lat = np.empty(header.arrivals, dtype=np.float64)
+    n = 0
+    for ev in events:
+        if n >= header.arrivals:
+            raise ValueError("trace has more arrivals than its header claims")
+        t[n], user[n], lat[n] = ev.t, ev.user, ev.lat
+        n += 1
+    if n != header.arrivals:
+        raise ValueError(f"trace has {n} arrivals, header claims {header.arrivals}")
+    return header, t, user, lat

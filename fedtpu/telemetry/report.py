@@ -75,6 +75,10 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     resume_rounds: List[int] = []
     diverged_at: Optional[dict] = None
     supervisor_exit: Optional[dict] = None
+    serve_ticks = 0
+    serve_last: Optional[dict] = None
+    serve_summary: Optional[dict] = None
+    starvation: List[dict] = []
     for e in events:
         v = e.get("v")
         if isinstance(v, int) and v > EVENT_SCHEMA_VERSION:
@@ -126,6 +130,17 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             diverged_at = {"round": e.get("round"), **payload}
         elif kind == "supervisor_exit":
             supervisor_exit = payload
+        # Serving timeline (fedtpu.serving; docs/serving.md). The drain
+        # summary carries the authoritative SLO numbers (admission
+        # counts, update-to-incorporation percentiles, rounds/sec);
+        # per-tick events supply the cadence when a run died pre-drain.
+        elif kind == "serve_tick":
+            serve_ticks += 1
+            serve_last = {"tick": e.get("round"), **payload}
+        elif kind == "serve_summary":
+            serve_summary = {"tick": e.get("round"), **payload}
+        elif kind == "async_starvation":
+            starvation.append({"round": e.get("round"), **payload})
 
     out: dict = {
         "events_total": len(events),
@@ -139,7 +154,15 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         "staleness": None,
         "counters": {}, "gauges": {}, "histograms": {},
         "resilience": None,
+        "serving": None,
     }
+    if serve_ticks or serve_summary or starvation:
+        out["serving"] = {
+            "ticks": serve_ticks,
+            "last_tick": serve_last,
+            "summary": serve_summary,
+            "starvation": starvation,
+        }
     if manifest:
         out["manifest"] = {k: manifest.get(k) for k in
                            ("config_hash", "package_version", "jax_version",
@@ -270,6 +293,33 @@ def render_text(agg: dict) -> str:
             se = res["supervisor_exit"]
             lines.append(f"  supervisor exit: rc={se.get('rc')} "
                          f"reason={se.get('reason')}")
+    srv = agg.get("serving")
+    if srv:
+        lines.append("serving:")
+        summ = srv.get("summary") or srv.get("last_tick") or {}
+        if srv.get("ticks") or summ.get("ticks"):
+            lines.append(f"  ticks: {summ.get('ticks', srv['ticks'])} "
+                         f"(incorporated {summ.get('incorporated', '?')} "
+                         f"update(s), version {summ.get('version', '?')})")
+        adm = summ.get("admission")
+        if adm:
+            lines.append("  admission: " + ", ".join(
+                f"{k}={adm[k]:g}" for k in sorted(adm)))
+        lat = summ.get("update_to_incorporation")
+        if lat:
+            lines.append(f"  update_to_incorporation p50 {lat['p50_s']:.4f} s"
+                         f"  p90 {lat['p90_s']:.4f} s  "
+                         f"p99 {lat['p99_s']:.4f} s  "
+                         f"mean {lat['mean_s']:.4f} s  "
+                         f"max {lat['max_s']:.4f} s")
+        if summ.get("rounds_per_sec") is not None:
+            lines.append(f"  rounds/sec under load: "
+                         f"{summ['rounds_per_sec']:.2f} "
+                         f"({summ.get('wall_s', 0.0):.2f} s wall)")
+        for sv in srv.get("starvation") or []:
+            lines.append(f"  K-BUFFER STARVATION @ tick {sv.get('round')}: "
+                         f"{sv.get('pending')} buffered update(s) never "
+                         f"reached buffer_size {sv.get('buffer_size')}")
     if agg.get("counters"):
         lines.append("counters:")
         for k, v in sorted(agg["counters"].items()):
@@ -309,6 +359,15 @@ def render_prometheus(agg: dict) -> str:
                        ("0.99", "p99_s")):
             n = _prom_name("round_duration_seconds")
             lines.append(f'{n}{{quantile="{q}"}} {cadence[key]:g}')
+    # Serving SLO quantiles from the drain summary (the exact-percentile
+    # view; the cumulative-bucket histogram below is the scrapeable one).
+    srv_lat = ((agg.get("serving") or {}).get("summary")
+               or {}).get("update_to_incorporation")
+    if srv_lat:
+        n = _prom_name("update_to_incorporation_seconds")
+        for q, key in (("0.5", "p50_s"), ("0.9", "p90_s"),
+                       ("0.99", "p99_s")):
+            lines.append(f'{n}{{quantile="{q}"}} {srv_lat[key]:g}')
     for name, h in sorted((agg.get("histograms") or {}).items()):
         n = _prom_name(name)
         lines.append(f"# TYPE {n} histogram")

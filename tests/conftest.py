@@ -179,6 +179,12 @@ QUICK_TESTS = {
     # both picks are backend-free and run in milliseconds)
     "test_resilience.py::test_plan_spec_forms_are_identical",
     "test_resilience.py::test_chunk_limit_isolates_fault_rounds",
+    # round-7 modules
+    # serving subsystem (admission + trace schema — both backend-free,
+    # milliseconds; the engine/socket tests stay full-tier)
+    "test_serving.py::"
+    "test_admission_check_order_is_rate_backpressure_staleness",
+    "test_serving.py::test_trace_roundtrip_and_header",
     # test_chaos_supervised runs supervised subprocess CLI children
     # (kill + restart, ~90 s) and stays full-tier only; the in-process
     # resilience semantics are covered by test_resilience above.

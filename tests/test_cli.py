@@ -217,7 +217,8 @@ def test_every_documented_flag_exists_in_the_parser():
     documented = set()
     for rel in ("README.md", "docs/API.md", "docs/ARCHITECTURE.md",
                 "docs/observability.md", "docs/analysis.md",
-                "docs/performance.md", "docs/resilience.md", "PARITY.md",
+                "docs/performance.md", "docs/resilience.md",
+                "docs/serving.md", "PARITY.md",
                 "benchmarks/RESULTS.md"):
         text = open(os.path.join(root, rel)).read()
         # Underscores ARE captured so `--dp_clip_norm`-style typos show up
@@ -229,6 +230,9 @@ def test_every_documented_flag_exists_in_the_parser():
                    "--out",                        # bench.py result file
                    "--eval-every",                 # accuracy_parity.py
                    "--min-speedup",                # benchmarks/compile_bench.py
+                   "--socket-events",              # benchmarks/serving_bench.py
+                   "--skip-socket",                # benchmarks/serving_bench.py
+                   "--trace",                      # benchmarks/async_bench.py
                    "--xla_force_host_platform_device_count",  # XLA flag
                    "--hostfile", "--np"}           # mpirun (reference docs)
     missing = documented - known - other_tools
