@@ -1,18 +1,31 @@
 """Client-scaling benchmark: sec/round at 1 -> 8 -> 32 clients (BASELINE.md
-config matrix), plus the CIFAR-10 ConvNet payload stress config.
+config matrix), plus the CIFAR-10 ConvNet payload stress config — and, with
+``--scale``, the population sweep (10k -> 1M simulated clients through the
+cohort store, docs/scaling.md).
 
 Prints one JSON line per config. On a single chip, clients beyond the device
 count vmap-oversubscribe (the analogue of `mpirun -np 32` on one node); on a
 v4-8/v4-32 the same code lays one client per core.
 
+``--scale`` runs each (total_clients, store backend) row in its OWN
+subprocess so per-row peak RSS (``ru_maxrss``) is independent — the point of
+the artifact is that peak host+device memory is flat in total client count
+(cohort-size dependent only), so rows must not inherit each other's
+high-water mark. Rows land in ``BENCH_SCALE.json``.
+
 Usage: python benchmarks/scaling.py [--rounds 20] [--rounds-per-step 10]
+       python benchmarks/scaling.py --scale [--total-clients 10000,100000,1000000]
+           [--store memory,mmap] [--cohort-size 64] [--out BENCH_SCALE.json]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import tempfile
 import time
 
 import jax
@@ -65,12 +78,177 @@ def bench_config(name: str, ds, model_cfg: ModelConfig, num_clients: int,
     }
 
 
+# ------------------------------------------------------------------ scale
+
+# memory-backend rows above this population are skipped by default: the
+# apparent store (total_clients x record_bytes) stops fitting comfortably
+# even though calloc keeps untouched pages virtual.
+MEMORY_STORE_CAP = 200_000
+
+
+def _device_peak_reported() -> int:
+    """Peak device allocation if the backend reports it (TPU/GPU); CPU
+    returns 0 and the sampled live-buffer high-water mark stands in."""
+    stats = {}
+    dev = jax.local_devices()[0]
+    if hasattr(dev, "memory_stats"):
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            stats = {}
+    return int(stats.get("peak_bytes_in_use") or 0)
+
+
+class _LiveBufferSampler:
+    """Background thread tracking max(sum of live jax array bytes) — the
+    CPU stand-in for an HBM high-water mark."""
+
+    def __init__(self, interval_s: float = 0.05):
+        import threading
+        self.peak = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, args=(interval_s,),
+                                   daemon=True)
+
+    def _run(self, interval_s):
+        while not self._stop.is_set():
+            try:
+                now = sum(int(a.nbytes) for a in jax.live_arrays())
+            except Exception:
+                now = 0
+            self.peak = max(self.peak, now)
+            self._stop.wait(interval_s)
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join()
+
+
+def bench_scale_row(total_clients: int, cohort_size: int, store: str,
+                    rounds: int, store_path: str | None) -> dict:
+    """One cohort-store row: run `rounds` full cohort rounds over a
+    `total_clients` simulated population and report peak host + device
+    memory. Meant to run in a fresh subprocess (see `main`)."""
+    import resource
+
+    from fedtpu.config import ExperimentConfig, FedConfig, RunConfig
+    from fedtpu.cohort.scheduler import run_cohort_experiment
+    from fedtpu.telemetry.metrics import default_registry
+
+    cfg = ExperimentConfig(
+        # Synthetic tabular rows: the sweep measures state scale, not data
+        # scale, so the sample pool stays fixed while clients grow.
+        data=DataConfig(csv_path=None, synthetic_rows=4096),
+        shard=ShardConfig(num_clients=total_clients),
+        model=ModelConfig(input_dim=14, num_classes=2, hidden_sizes=(8,)),
+        optim=OptimConfig(),
+        fed=FedConfig(rounds=rounds, cohort_size=cohort_size,
+                      client_store=store, client_store_path=store_path),
+        run=RunConfig(log_every=max(1, rounds), rounds_per_step=1),
+    )
+    t0 = time.perf_counter()
+    with _LiveBufferSampler() as sampler:
+        res = run_cohort_experiment(cfg, verbose=False)
+    wall = time.perf_counter() - t0
+    reg = default_registry()
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    # Linux reports ru_maxrss in KiB.
+    peak_rss = int(ru.ru_maxrss) * 1024
+    return {
+        "config": f"cohort-{store}-{total_clients}",
+        "total_clients": total_clients,
+        "cohort_size": cohort_size,
+        "store": store,
+        "rounds": res.rounds_run,
+        "sec_per_round": round(float(np.mean(res.sec_per_round)), 9),
+        "wall_s": round(wall, 3),
+        "peak_rss_bytes": peak_rss,
+        "device_peak_bytes": _device_peak_reported() or sampler.peak,
+        "store_apparent_bytes": int(
+            reg.gauge("client_store_apparent_bytes").value),
+        "store_resident_bytes": int(
+            reg.gauge("client_store_resident_bytes").value),
+        "backend": jax.local_devices()[0].platform,
+    }
+
+
+def run_scale_sweep(args) -> list:
+    """Fan the sweep out one row per subprocess (independent ru_maxrss);
+    each child re-enters this script with the hidden --scale-row flag."""
+    totals = [int(t) for t in str(args.total_clients).split(",") if t]
+    stores = [s.strip() for s in str(args.store).split(",") if s.strip()]
+    rows = []
+    for total in totals:
+        for store in stores:
+            if store == "memory" and total > MEMORY_STORE_CAP:
+                print(f"# skip cohort-memory-{total}: memory backend capped "
+                      f"at {MEMORY_STORE_CAP} clients (use mmap)",
+                      file=sys.stderr, flush=True)
+                continue
+            with tempfile.TemporaryDirectory() as tmp:
+                cmd = [sys.executable, os.path.abspath(__file__),
+                       "--scale-row", "--total-clients", str(total),
+                       "--store", store,
+                       "--cohort-size", str(args.cohort_size),
+                       "--scale-rounds", str(args.scale_rounds)]
+                if store == "mmap":
+                    cmd += ["--store-path",
+                            os.path.join(tmp, "client_store.bin")]
+                out = subprocess.run(cmd, capture_output=True, text=True)
+                if out.returncode != 0:
+                    raise RuntimeError(
+                        f"scale row {store}/{total} failed:\n"
+                        + out.stderr[-4000:])
+                row = json.loads(out.stdout.strip().splitlines()[-1])
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=20)
     ap.add_argument("--rounds-per-step", type=int, default=10)
     ap.add_argument("--skip-cifar", action="store_true")
+    # Population sweep through the cohort store (docs/scaling.md).
+    ap.add_argument("--scale", action="store_true",
+                    help="run the cohort population sweep instead of the "
+                         "vmap config matrix; writes --out")
+    ap.add_argument("--total-clients", default="10000,100000,1000000",
+                    help="comma list of simulated population sizes")
+    ap.add_argument("--store", default="memory,mmap",
+                    help="comma list of store backends to sweep")
+    ap.add_argument("--cohort-size", type=int, default=64)
+    ap.add_argument("--scale-rounds", type=int, default=2)
+    ap.add_argument("--out", default=None,
+                    help="write sweep rows to this JSON file "
+                         "(default BENCH_SCALE.json next to this script)")
+    ap.add_argument("--scale-row", action="store_true",
+                    help=argparse.SUPPRESS)   # internal: one row, this proc
+    ap.add_argument("--store-path", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.scale_row:
+        row = bench_scale_row(int(args.total_clients), args.cohort_size,
+                              args.store, args.scale_rounds, args.store_path)
+        print(json.dumps(row), flush=True)
+        return
+
+    if args.scale:
+        rows = run_scale_sweep(args)
+        out = args.out or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..",
+            "BENCH_SCALE.json")
+        with open(out, "w") as f:
+            json.dump({"rows": rows, "cohort_size": args.cohort_size,
+                       "rounds_per_row": args.scale_rounds}, f, indent=2)
+            f.write("\n")
+        print(f"# wrote {os.path.normpath(out)}", file=sys.stderr)
+        return
 
     from fedtpu.utils.timing import measured_peak_flops
 

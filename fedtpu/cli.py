@@ -304,6 +304,28 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
     if getattr(args, "buffer_size", None) is not None:
         fed = dataclasses.replace(fed,
                                   async_buffer_size=args.buffer_size)
+    if getattr(args, "cohort_size", None) is not None:
+        fed = dataclasses.replace(fed, cohort_size=args.cohort_size)
+    elif any(getattr(args, a, None) is not None
+             for a in ("client_store", "client_store_path",
+                       "cohort_sampling", "cohort_seed", "cohort_trace")):
+        # Same rule as the async knobs: never silently ignore a semantic
+        # flag whose engine mode is off.
+        raise SystemExit("--client-store/--client-store-path/"
+                         "--cohort-sampling/--cohort-seed/--cohort-trace "
+                         "require --cohort-size")
+    if getattr(args, "client_store", None) is not None:
+        fed = dataclasses.replace(fed, client_store=args.client_store)
+    if getattr(args, "client_store_path", None) is not None:
+        fed = dataclasses.replace(fed,
+                                  client_store_path=args.client_store_path)
+    if getattr(args, "cohort_sampling", None) is not None:
+        fed = dataclasses.replace(fed,
+                                  cohort_sampling=args.cohort_sampling)
+    if getattr(args, "cohort_seed", None) is not None:
+        fed = dataclasses.replace(fed, cohort_seed=args.cohort_seed)
+    if getattr(args, "cohort_trace", None) is not None:
+        fed = dataclasses.replace(fed, cohort_trace=args.cohort_trace)
     run_kw = {}
     if args.checkpoint_dir is not None:
         run_kw["checkpoint_dir"] = args.checkpoint_dir
@@ -423,6 +445,36 @@ def build_parser() -> argparse.ArgumentParser:
                             "apply semantics — the global only moves once "
                             "this many updates sit in the server buffer "
                             "(default 0 = apply every arrival tick)")
+    # run-only: the cohort-store engine (fedtpu.cohort; docs/scaling.md).
+    # --num-clients is the POPULATION; --cohort-size is how many of them
+    # exist on device per round.
+    run_p.add_argument("--cohort-size", type=_positive_int, default=None,
+                       help="stream rounds through a sampled cohort of "
+                            "this many clients instead of materializing "
+                            "all --num-clients on device; per-client "
+                            "state lives in a host-side store (plain "
+                            "FedAvg path only; bitwise-equal to the "
+                            "default engine when equal to --num-clients)")
+    run_p.add_argument("--client-store", choices=["memory", "mmap"],
+                       default=None,
+                       help="cohort store backend: 'memory' (sparse "
+                            "calloc pages) or 'mmap' (file-backed, "
+                            "survives as a plain binary; default memory)")
+    run_p.add_argument("--client-store-path", default=None, metavar="BIN",
+                       help="mmap store backing file (default "
+                            "<checkpoint-dir>/client_store.bin)")
+    run_p.add_argument("--cohort-sampling",
+                       choices=["uniform", "weighted", "trace"],
+                       default=None,
+                       help="cohort sampling policy: uniform, weighted "
+                            "(data-size-proportional), or trace (arrival "
+                            "order of --cohort-trace)")
+    run_p.add_argument("--cohort-seed", type=int, default=None,
+                       help="cohort sampling seed (default 0; resume "
+                            "replays the same cohorts)")
+    run_p.add_argument("--cohort-trace", default=None, metavar="JSONL",
+                       help="serving trace whose arrival order drives "
+                            "--cohort-sampling trace")
     # run-only, like --aggregation: the sweep/parity programs would accept
     # but silently ignore it.
     run_p.add_argument("--personalize-steps", type=_positive_int,
@@ -718,8 +770,9 @@ def build_parser() -> argparse.ArgumentParser:
                          help="write the bound port here once listening "
                               "(ephemeral-port discovery for loadgen)")
     serve_p.add_argument("--cohort", type=_positive_int, default=8,
-                         help="concurrent engine slots C; user u maps to "
-                              "slot u %% C (default 8)")
+                         help="concurrent engine slots C; users get "
+                              "stable slot bindings with LRU eviction "
+                              "(default 8)")
     serve_p.add_argument("--buffer-size", type=_nonnegative_int, default=0,
                          help="FedBuff K-buffer M: the global only moves "
                               "once M updates buffered (<=1 applies every "

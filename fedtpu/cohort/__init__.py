@@ -1,0 +1,26 @@
+"""Cohort subsystem: client state as STORAGE, not live engine slots.
+
+The vmap engines (fedtpu.parallel.round / async_fed) materialize every
+client's state on device every round — population is capped by HBM. This
+package inverts that: the population lives in a
+:class:`~fedtpu.cohort.store.ClientStateStore` (one versioned record per
+client id, memory- or mmap-backed, shardable across hosts), and each
+round a :class:`~fedtpu.cohort.scheduler.CohortScheduler` samples a
+cohort, streams exactly those records host→device with double-buffered
+prefetch, runs the round as a scan-over-cohorts with donated buffers,
+and writes the updated records back. Peak memory is cohort-size
+dependent only — flat in total client count (docs/scaling.md).
+"""
+
+from fedtpu.cohort.store import ClientStateStore
+from fedtpu.cohort.scheduler import (CohortSampler, CohortScheduler,
+                                     build_cohort_round_fn,
+                                     run_cohort_experiment)
+
+__all__ = [
+    "ClientStateStore",
+    "CohortSampler",
+    "CohortScheduler",
+    "build_cohort_round_fn",
+    "run_cohort_experiment",
+]

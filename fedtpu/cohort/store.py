@@ -1,0 +1,282 @@
+"""ClientStateStore: one versioned record per client id, off-device.
+
+The store holds the PER-CLIENT portion of an engine state — the leaves
+:func:`fedtpu.parallel.round.per_client_view` selects (params, optimizer
+moments, async anchors/pull ticks, SCAFFOLD variates) — as fixed-width
+byte records in a single ``(rows, record_bytes)`` uint8 array, plus a
+small per-record header:
+
+    offset 0   version       uint64   0 = never initialized
+    offset 8   participation uint64   rounds this client trained in
+    offset 16  rng_key       2xuint32 per-client PRNG key data
+    offset 24  leaf 0 bytes (raw, exact dtype), 8-byte padded
+               leaf 1 bytes ...
+
+Raw-byte records round-trip every dtype bitwise (f32 params, i32 Adam
+counts, i32 pull ticks) — the store is a persistence layer, never a
+numeric one, which is what makes cohort-mode parity with the vmap path
+an exact, testable property rather than a tolerance.
+
+Backends: ``memory`` (anonymous ``np.zeros`` — calloc-backed, so
+untouched rows stay virtual there too, but the array dies with the
+process) and ``mmap`` (file-backed ``np.memmap`` — the file is APPARENT
+size ``rows * record_bytes`` but sparse: only pages actually written
+occupy RAM/disk blocks, so resident memory scales with TOUCHED records
+(~ rounds x cohort), not with the population; docs/scaling.md has the
+measured numbers).
+
+Sharding across hosts: shard ``s`` of ``S`` owns ids with
+``id % S == s``, stored at row ``id // S`` of its own array/file. Each
+host constructs its shard and only ever reads/writes owned ids; the
+scheduler routes cohort members to their owners (single-host runs use
+the default 1-shard store).
+
+Checkpoint/restore is Orbax-compatible two ways: ``save``/``restore``
+write a standalone PyTree item ({ids, records} of touched rows only, so
+checkpoint size is bounded by participation, not population), and
+``checkpoint_arrays``/``restore_arrays`` expose the same arrays for
+embedding in a run checkpoint's meta item — one atomic orbax commit
+covers engine state AND store, so resume can never see one without the
+other.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+HEADER_BYTES = 24
+_VER_OFF = 0
+_PART_OFF = 8
+_KEY_OFF = 16
+
+BACKENDS = ("memory", "mmap")
+
+
+def _pad8(n: int) -> int:
+    return (n + 7) // 8 * 8
+
+
+def state_template(state, num_slots: int) -> List[Tuple[tuple, np.dtype]]:
+    """The store template for an engine state: ``(trailing_shape, dtype)``
+    per per-client leaf, in :func:`per_client_view` order. Works on sync
+    and async state layouts alike."""
+    from fedtpu.parallel.round import per_client_view
+    return [(tuple(l.shape[1:]), np.dtype(l.dtype))
+            for l in per_client_view(state, num_slots)]
+
+
+class ClientStateStore:
+    """Fixed-width record store keyed by client id. See module docstring
+    for the record layout, backends, sharding, and checkpoint story."""
+
+    def __init__(self, template: Sequence[Tuple[tuple, np.dtype]],
+                 total_clients: int, backend: str = "memory",
+                 path: Optional[str] = None,
+                 shard_index: int = 0, num_shards: int = 1):
+        if backend not in BACKENDS:
+            raise ValueError(f"client store backend must be one of "
+                             f"{BACKENDS}, got {backend!r}")
+        if backend == "mmap" and not path:
+            raise ValueError("mmap client store needs a path "
+                             "(--client-store-path)")
+        if total_clients <= 0:
+            raise ValueError(f"total_clients must be > 0, got "
+                             f"{total_clients}")
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(f"shard_index {shard_index} out of range for "
+                             f"{num_shards} shards")
+        self.template = [(tuple(s), np.dtype(d)) for s, d in template]
+        self.total_clients = int(total_clients)
+        self.backend = backend
+        self.path = path
+        self.shard_index = int(shard_index)
+        self.num_shards = int(num_shards)
+        self._offsets: List[int] = []
+        off = HEADER_BYTES
+        for shape, dtype in self.template:
+            self._offsets.append(off)
+            off += _pad8(int(np.prod(shape, dtype=np.int64)) * dtype.itemsize)
+        self.record_bytes = off
+        self.rows = len(range(self.shard_index, self.total_clients,
+                              self.num_shards))
+        if backend == "memory":
+            # calloc-backed: untouched rows stay virtual.
+            self._arr = np.zeros((self.rows, self.record_bytes), np.uint8)
+        else:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            want = self.rows * self.record_bytes
+            fresh = (not os.path.exists(path)
+                     or os.path.getsize(path) != want)
+            self._arr = np.memmap(path, dtype=np.uint8,
+                                  mode="w+" if fresh else "r+",
+                                  shape=(self.rows, self.record_bytes))
+        self._touched: set = set()
+
+    # -- id routing ----------------------------------------------------
+    def owns(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        return (ids % self.num_shards) == self.shard_index
+
+    def _rows_for(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.total_clients):
+            raise ValueError(
+                f"client id out of range [0, {self.total_clients}): "
+                f"{ids[(ids < 0) | (ids >= self.total_clients)][:4]}")
+        if not np.all(self.owns(ids)):
+            bad = ids[~self.owns(ids)][:4]
+            raise ValueError(
+                f"ids {bad} not owned by shard {self.shard_index}/"
+                f"{self.num_shards} — route cohort members to their "
+                f"owning shard")
+        return ids // self.num_shards
+
+    # -- header fields -------------------------------------------------
+    def versions(self, ids) -> np.ndarray:
+        rows = self._rows_for(ids)
+        raw = np.ascontiguousarray(
+            self._arr[rows, _VER_OFF:_VER_OFF + 8])
+        return raw.view(np.uint64).reshape(-1)
+
+    def participation(self, ids) -> np.ndarray:
+        rows = self._rows_for(ids)
+        raw = np.ascontiguousarray(
+            self._arr[rows, _PART_OFF:_PART_OFF + 8])
+        return raw.view(np.uint64).reshape(-1)
+
+    def read_keys(self, ids) -> np.ndarray:
+        """(K, 2) uint32 per-client PRNG key data."""
+        rows = self._rows_for(ids)
+        raw = np.ascontiguousarray(self._arr[rows, _KEY_OFF:_KEY_OFF + 8])
+        return raw.view(np.uint32).reshape(-1, 2)
+
+    # -- records -------------------------------------------------------
+    def read(self, ids) -> List[np.ndarray]:
+        """The stored leaves for ``ids``: one ``(K, *shape)`` array per
+        template leaf, bitwise as written. Records with version 0 return
+        their zero-fill — callers gate on :meth:`versions`."""
+        rows_idx = self._rows_for(ids)
+        rows = np.asarray(self._arr[rows_idx])  # fancy index: a copy
+        out = []
+        for (shape, dtype), off in zip(self.template, self._offsets):
+            nb = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            flat = np.ascontiguousarray(rows[:, off:off + nb])
+            out.append(flat.view(dtype).reshape((len(rows_idx),) + shape))
+        return out
+
+    def write(self, ids, leaves: Sequence, keys=None,
+              participated: bool = True) -> None:
+        """Write ``leaves`` (the :meth:`read` layout, exact dtypes
+        enforced) for distinct ``ids``; version += 1, participation += 1
+        when ``participated``, PRNG keys updated when ``keys`` given."""
+        ids = np.asarray(ids, np.int64)
+        if len(np.unique(ids)) != ids.size:
+            raise ValueError("write ids must be distinct within one call")
+        if len(leaves) != len(self.template):
+            raise ValueError(f"expected {len(self.template)} leaves, got "
+                             f"{len(leaves)}")
+        rows_idx = self._rows_for(ids)
+        rows = np.asarray(self._arr[rows_idx])
+        k = ids.size
+        for (shape, dtype), off, leaf in zip(self.template, self._offsets,
+                                             leaves):
+            # Host persistence of an already-fetched round result; the
+            # device round itself never syncs through here.
+            arr = np.asarray(leaf)  # fedtpu: noqa[FTP001] host-side store writeback, off the step's hot path by design
+            if arr.shape != (k,) + shape or arr.dtype != dtype:
+                raise ValueError(
+                    f"leaf mismatch: got {arr.dtype}{arr.shape}, store "
+                    f"holds {dtype}{(k,) + shape}")
+            rows[:, off:off + arr.nbytes // k] = \
+                np.ascontiguousarray(arr).reshape(k, -1).view(np.uint8)
+        ver = np.ascontiguousarray(
+            rows[:, _VER_OFF:_VER_OFF + 8]).view(np.uint64).reshape(-1)
+        rows[:, _VER_OFF:_VER_OFF + 8] = \
+            (ver + 1).reshape(k, 1).view(np.uint8)
+        if participated:
+            part = np.ascontiguousarray(
+                rows[:, _PART_OFF:_PART_OFF + 8]).view(
+                    np.uint64).reshape(-1)
+            rows[:, _PART_OFF:_PART_OFF + 8] = \
+                (part + 1).reshape(k, 1).view(np.uint8)
+        if keys is not None:
+            kk = np.ascontiguousarray(np.asarray(keys, np.uint32))
+            if kk.shape != (k, 2):
+                raise ValueError(f"keys must be (K, 2) uint32, got "
+                                 f"{kk.shape}")
+            rows[:, _KEY_OFF:_KEY_OFF + 8] = kk.view(np.uint8)
+        self._arr[rows_idx] = rows
+        self._touched.update(int(i) for i in ids)
+
+    def flush(self) -> None:
+        if self.backend == "mmap":
+            self._arr.flush()
+
+    # -- memory accounting --------------------------------------------
+    @property
+    def apparent_nbytes(self) -> int:
+        """Full logical size: rows x record_bytes. NOT resident memory —
+        both backends keep untouched rows virtual."""
+        return self.rows * self.record_bytes
+
+    def resident_estimate_bytes(self) -> int:
+        """Touched-record footprint — the part that can actually be
+        resident. Participation-bounded, population-independent."""
+        return len(self._touched) * self.record_bytes
+
+    def file_block_bytes(self) -> int:
+        """Actual disk blocks of the mmap file (0 for memory backend) —
+        the ground-truth sparsity measurement for BENCH_SCALE.json."""
+        if self.backend != "mmap":
+            return 0
+        self.flush()
+        return os.stat(self.path).st_blocks * 512
+
+    # -- checkpoint / restore -----------------------------------------
+    def checkpoint_arrays(self) -> dict:
+        """Touched rows as plain numpy — suitable for a run checkpoint's
+        orbax meta item (zero-length arrays are dropped by
+        save_checkpoint when nothing is touched; restore treats missing
+        keys as an empty store)."""
+        ids = np.array(sorted(self._touched), np.int64)
+        recs = (np.asarray(self._arr[self._rows_for(ids)])
+                if ids.size else np.zeros((0, self.record_bytes), np.uint8))
+        return {"store_ids": ids, "store_records": recs,
+                "store_record_bytes": np.int64(self.record_bytes),
+                "store_total_clients": np.int64(self.total_clients)}
+
+    def restore_arrays(self, arrays: dict) -> None:
+        """Load rows saved by :meth:`checkpoint_arrays`; validates the
+        record geometry so a changed model/optimizer fails loudly rather
+        than reinterpreting bytes."""
+        ids = np.asarray(arrays.get("store_ids",
+                                    np.zeros((0,), np.int64)), np.int64)
+        recs = np.asarray(arrays.get(
+            "store_records", np.zeros((0, self.record_bytes), np.uint8)),
+            np.uint8)
+        rb = int(arrays.get("store_record_bytes", self.record_bytes))
+        tc = int(arrays.get("store_total_clients", self.total_clients))
+        if rb != self.record_bytes or tc != self.total_clients:
+            raise ValueError(
+                f"store checkpoint geometry mismatch: saved "
+                f"record_bytes={rb} total_clients={tc}, store has "
+                f"{self.record_bytes}/{self.total_clients}")
+        if ids.size:
+            self._arr[self._rows_for(ids)] = recs
+            self._touched.update(int(i) for i in ids)
+
+    def save(self, directory: str) -> None:
+        """Standalone Orbax checkpoint of the touched rows."""
+        import orbax.checkpoint as ocp
+        ocp.PyTreeCheckpointer().save(
+            os.path.abspath(directory), self.checkpoint_arrays(),
+            force=True)
+
+    def restore(self, directory: str) -> None:
+        import orbax.checkpoint as ocp
+        self.restore_arrays(
+            ocp.PyTreeCheckpointer().restore(os.path.abspath(directory)))

@@ -255,6 +255,25 @@ class FedConfig:
     # moves once this many updates sit in the server buffer (buffer state
     # checkpoints with the run). <= 1 applies every arrival tick.
     async_buffer_size: int = 0
+    # Cohort-store engine (fedtpu.cohort; docs/scaling.md): > 0 selects
+    # the streaming cohort scheduler instead of the all-clients vmap
+    # engine. The population (shard.num_clients) lives in a versioned
+    # ClientStateStore; each round samples cohort_size clients, streams
+    # exactly their records host->device (double-buffered prefetch), and
+    # writes them back — peak memory is cohort-size dependent only, flat
+    # in total client count. Plain-FedAvg sync path only (the scan body
+    # is the vmap round op for op — bitwise-equal when cohort ==
+    # population); composition with server_opt/DP/robust/compress/
+    # scaffold/async is rejected loudly.
+    cohort_size: int = 0
+    client_store: str = "memory"         # 'memory' | 'mmap' record backend
+    # mmap backing file; None = <checkpoint_dir>/client_store.bin.
+    client_store_path: Optional[str] = None
+    cohort_sampling: str = "uniform"     # 'uniform' | 'weighted' | 'trace'
+    cohort_seed: int = 0
+    # Serving-trace file (fedtpu.serving.traces) whose arrival order
+    # drives 'trace' sampling: cohorts are the next distinct users.
+    cohort_trace: Optional[str] = None
     # The reference reads its stop signal one loop-top late (:132 vs :195)
     # but the doomed iteration breaks before training — no extra round is
     # trained, so there is no lag to reproduce (tests/test_stop_lag.py
@@ -367,10 +386,12 @@ class ServingConfig:
     (fedtpu.serving; docs/serving.md).
 
     A bounded cohort of ``cohort`` engine slots absorbs an unbounded
-    user population (user -> slot ``user % cohort``); admitted updates
-    become DRIVEN async FedBuff ticks. All admission/staleness/latency
-    decisions run on the VIRTUAL clock carried by arrival timestamps,
-    so identical trace + seed replays bitwise-identically."""
+    user population (stable user -> slot bindings with LRU eviction —
+    see fedtpu.serving.engine.SlotBinder; optionally store-backed for
+    true per-user identity); admitted updates become DRIVEN async
+    FedBuff ticks. All admission/staleness/latency decisions run on the
+    VIRTUAL clock carried by arrival timestamps, so identical trace +
+    seed replays bitwise-identically."""
 
     host: str = "127.0.0.1"        # ingestion socket binds localhost only
     port: int = 0                  # 0 = ephemeral (see --port-file)

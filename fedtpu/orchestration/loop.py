@@ -542,6 +542,16 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     #   * control flow (early stop, divergence, round counters) stays
     #     identical on every process because it is derived from the
     #     replicated metrics.
+    # Cohort-store engine mode (fedtpu.cohort; docs/scaling.md): the
+    # population lives in a host-side ClientStateStore and only
+    # cohort_size slots exist on device — the round loop, prefetch, and
+    # store writeback all live in run_cohort_experiment. Same config
+    # surface, same ExperimentResult, bitwise-equal to this loop when
+    # cohort_size == num_clients (tests/test_cohort.py).
+    if cfg.fed.cohort_size > 0:
+        from fedtpu.cohort.scheduler import run_cohort_experiment
+        return run_cohort_experiment(cfg, dataset=dataset, verbose=verbose,
+                                     resume=resume)
     # Resilience knob validation FIRST — before any build/compile work,
     # so a bad combination fails in milliseconds, not after a compile.
     if cfg.run.on_divergence not in ("halt", "rollback"):

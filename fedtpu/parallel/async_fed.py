@@ -346,6 +346,29 @@ def build_async_round_fn(mesh, apply_fn: Callable,
     return step
 
 
+@partial(jax.jit, static_argnums=(1,))
+def read_client_slot(state, num_clients: int, slot):  # fedtpu: noqa[FTP003] read-only gather: the caller keeps training on `state` after persisting the slot; donating would invalidate the live engine state
+    """The per-client leaves of engine slot ``slot``, as a flat list in
+    :func:`fedtpu.parallel.round.per_client_view` order. ``slot`` is a
+    traced index (one compile covers every slot). The serving engine's
+    slot binder uses this to persist an evicted user's state into the
+    client store before rebinding the slot to a newcomer."""
+    from fedtpu.parallel.round import per_client_view
+    return [l[slot] for l in per_client_view(state, num_clients)]
+
+
+@partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def write_client_slot(state, num_clients: int, slot, values):
+    """Rebind engine slot ``slot``'s per-client leaves to ``values``
+    (the :func:`read_client_slot` layout — store records round-trip
+    bitwise). Donates the input state; the caller rebinds."""
+    from fedtpu.parallel.round import per_client_view, with_per_client
+    leaves = per_client_view(state, num_clients)
+    new = [l.at[slot].set(jnp.asarray(v).astype(l.dtype))
+           for l, v in zip(leaves, values)]
+    return with_per_client(state, num_clients, new)
+
+
 @jax.jit
 def _freshest_anchor(pull_tick, anchors):
     idx = jnp.argmax(pull_tick)

@@ -949,6 +949,48 @@ def global_params(state):
     return jax.tree.map(lambda p: p[0], state["params"])
 
 
+def per_client_view(state, num_clients: int):
+    """The PER-CLIENT leaves of a federated state, in flatten order.
+
+    A state dict mixes two kinds of leaves: per-client ones carrying a
+    leading ``(num_clients, ...)`` axis (params, Adam moments, SCAFFOLD
+    client variates, async anchors/pull ticks) and replicated server
+    scalars/pytrees (round counter, server optimizer state, buffers).
+    The cohort subsystem (fedtpu.cohort) persists exactly the per-client
+    portion — one record per client id — so both engines and the store
+    must agree on WHICH leaves those are. The single rule, applied here
+    and only here: ``ndim >= 1 and shape[0] == num_clients``.
+
+    Returns the per-client leaves only, ordered by ``jax.tree.flatten``
+    of the full state; pair with :func:`with_per_client` to rebuild a
+    state around replaced per-client leaves. Works on both the sync
+    (fedtpu.parallel.round) and async (fedtpu.parallel.async_fed) state
+    layouts, and on host-numpy as well as device trees."""
+    leaves = jax.tree.leaves(state)
+    return [l for l in leaves
+            if getattr(l, "ndim", 0) >= 1 and l.shape[0] == num_clients]
+
+
+def with_per_client(state, num_clients: int, new_leaves):
+    """Rebuild ``state`` with its per-client leaves (the
+    :func:`per_client_view` selection, same order) replaced by
+    ``new_leaves``; replicated leaves pass through untouched."""
+    leaves, treedef = jax.tree.flatten(state)
+    it = iter(new_leaves)
+    out = []
+    for l in leaves:
+        if getattr(l, "ndim", 0) >= 1 and l.shape[0] == num_clients:
+            out.append(next(it))
+        else:
+            out.append(l)
+    rest = list(it)
+    if rest:
+        raise ValueError(
+            f"with_per_client: {len(rest)} replacement leaves left over — "
+            "the replacement list must match per_client_view's selection")
+    return jax.tree.unflatten(treedef, out)
+
+
 def build_eval_fn(apply_fn: Callable, num_classes: int):
     """Held-out evaluation of the global model — NEW relative to the
     reference, which broadcasts a test split it never uses

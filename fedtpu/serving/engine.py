@@ -2,13 +2,26 @@
 
 The bridge between the ingestion path (traces / sockets / admission) and
 the in-graph async engine. A bounded COHORT of ``C`` engine slots stands
-in for millions of users — user ``u`` maps to slot ``u % C`` — so engine
-memory is cohort-sized while the arrival stream is unbounded. Admitted
-updates queue per slot; when a tick fires, every slot with an eligible
+in for millions of users — each user gets a STABLE slot through a
+:class:`SlotBinder` (LRU over the C slots), so two concurrently-active
+users never share a slot (the old ``user % C`` residue map aliased them:
+user 0 and user C trained each other's slot). Engine memory stays
+cohort-sized while the arrival stream is unbounded. Admitted updates
+queue per user; when a tick fires, every bound slot with an eligible
 queued update "arrives" in that tick's ``(1, C)`` mask and the driven
 step (``build_async_round_fn(driven=True)``) trains exactly those slots.
 Multiple updates queued on one slot coalesce into that one arrival —
 tick count scales with the flush cadence, not the arrival count.
+
+Eviction (a new user arriving with all C slots bound) reclaims the
+least-recently-active user's slot. Without a store the incoming user
+inherits the evictee's warm slot state (documented approximation —
+exactly what EVERY user suffered under the residue map). With a
+:class:`fedtpu.cohort.store.ClientStateStore` attached
+(:meth:`ServingEngine.attach_store`), eviction persists the evictee's
+per-slot engine state to its own record and loads the incoming user's
+record back into the slot — true per-user identity over an unbounded
+population, cohort-sized device memory.
 
 Two clocks, deliberately separate:
 
@@ -88,6 +101,66 @@ class _Pending:
     t: float            # virtual arrival time
     user: int
     elig_tick: int      # first tick index this entry may ride
+
+
+class SlotBinder:
+    """Stable user -> engine-slot binding with LRU eviction.
+
+    Replaces the residue map ``user % C``: a binding, once made, holds
+    until the user is the least-recently-active one AND a new user needs
+    a slot — so no two simultaneously-active users ever share a slot.
+    All decisions are pure functions of the (deterministic) bind-call
+    order, keeping trace replays bitwise-identical. Recency is
+    participation order, touched once per ``bind``.
+    """
+
+    def __init__(self, capacity: int):
+        from collections import OrderedDict
+        self.capacity = int(capacity)
+        self._slot_of: dict = {}
+        self._order = OrderedDict()          # oldest-bound-user first
+        # pop() hands out the lowest free slot first, so a fresh binder
+        # fills slots 0, 1, 2, ... in first-arrival order.
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.evictions = 0
+
+    def peek(self, user: int):
+        """The user's current slot, or None — no recency touch."""
+        return self._slot_of.get(int(user))
+
+    def bind(self, user: int):
+        """Return ``(slot, evicted_user)``; ``evicted_user`` is None
+        unless this bind reclaimed an LRU slot."""
+        user = int(user)
+        if user in self._slot_of:
+            self._order.move_to_end(user)
+            return self._slot_of[user], None
+        if self._free:
+            slot, evicted = self._free.pop(), None
+        else:
+            evicted, _ = self._order.popitem(last=False)
+            slot = self._slot_of.pop(evicted)
+            self.evictions += 1
+        self._slot_of[user] = slot
+        self._order[user] = None
+        return slot, evicted
+
+    def state(self) -> dict:
+        """Checkpoint view: users in LRU order + their slots."""
+        users = list(self._order)
+        return {"users": np.asarray(users, np.int64),
+                "slots": np.asarray([self._slot_of[u] for u in users],
+                                    np.int64),
+                "evictions": np.int64(self.evictions)}
+
+    def restore_state(self, users, slots, evictions: int = 0) -> None:
+        from collections import OrderedDict
+        self._slot_of = {int(u): int(s) for u, s in zip(users, slots)}
+        self._order = OrderedDict((int(u), None) for u in users)
+        bound = set(self._slot_of.values())
+        self._free = [s for s in range(self.capacity - 1, -1, -1)
+                      if s not in bound]
+        self.evictions = int(evictions)
 
 
 @dataclass
@@ -197,6 +270,8 @@ class ServingEngine:
 
         # Host-side serving state (all of it checkpointed; see
         # checkpoint()/restore()).
+        self.binder = SlotBinder(self.C)
+        self.store = None            # optional ClientStateStore (attach_store)
         self.pending: list[_Pending] = []
         self.tick_count = 0
         self.version = 0
@@ -239,9 +314,10 @@ class ServingEngine:
               version: Optional[int] = None) -> str:
         """Admit (or not) one arriving update; fires any due ticks first.
 
-        Returns the admission verdict. Admitted updates queue on slot
-        ``user % cohort`` and become eligible at the NEXT tick (one tick
-        later when deprioritized).
+        Returns the admission verdict. Admitted updates queue per USER
+        (the slot is bound at tick time by the :class:`SlotBinder`) and
+        become eligible at the NEXT tick (one tick later when
+        deprioritized).
         """
         self.clock.advance(t)
         self._fire_due()
@@ -268,6 +344,45 @@ class ServingEngine:
             v = self.offer(float(t), int(user), float(lat))
             counts[v] = counts.get(v, 0) + 1
         return counts
+
+    # ------------------------------------------------------------------
+    # per-user identity (cohort store backing)
+
+    def attach_store(self, total_users: int, backend: str = "memory",
+                     path: Optional[str] = None):
+        """Back slot eviction with a per-user state store: each of
+        ``total_users`` user ids owns one record shaped like a single
+        engine slot (params, anchor, optimizer moments, pull tick).
+        From now on, evicting a user persists its slot into its record,
+        and a returning user's record is loaded back into the slot it
+        lands on — true per-user identity over a population far larger
+        than the C device slots. Returns the store (callers checkpoint
+        it through :meth:`checkpoint`, which attaches its touched rows
+        to the same orbax commit as the engine state)."""
+        from fedtpu.cohort.store import ClientStateStore, state_template
+        self.store = ClientStateStore(
+            state_template(self.state, self.C), total_users,
+            backend=backend, path=path)
+        return self.store
+
+    def _swap_slot(self, slot: int, evicted_user: int,
+                   new_user: int) -> None:
+        """Store-backed eviction: persist the evictee's slot record,
+        then load the incomer's record into the slot (first-ever users
+        have no record and inherit the slot's warm state — their record
+        is created when THEY are evicted)."""
+        from fedtpu.parallel.async_fed import (read_client_slot,
+                                               write_client_slot)
+        vals = read_client_slot(self.state, self.C, slot)
+        self.store.write(
+            np.asarray([evicted_user], np.int64),
+            [np.asarray(v)[None] for v in vals])  # fedtpu: noqa[FTP001] eviction writeback is a host store path, off the tick's device step
+        if int(self.store.versions(
+                np.asarray([new_user], np.int64))[0]) > 0:
+            rec = self.store.read(np.asarray([new_user], np.int64))
+            self.state = write_client_slot(self.state, self.C, slot,
+                                           [r[0] for r in rec])
+        self.registry.counter("serve_slot_evictions").inc()
 
     # ------------------------------------------------------------------
     # ticking
@@ -298,7 +413,16 @@ class ServingEngine:
             return 0
         self.pending = [p for p in self.pending
                         if not (drain or p.elig_tick <= k)]
-        slots = sorted({p.user % self.C for p in ready})
+        # Stable identity binding, in arrival order (deterministic under
+        # replay). Two distinct ready users always land on two distinct
+        # slots — the residue map's aliasing cannot happen.
+        tick_slots = set()
+        for p in ready:
+            slot, evicted = self.binder.bind(p.user)
+            if evicted is not None and self.store is not None:
+                self._swap_slot(slot, evicted, p.user)
+            tick_slots.add(slot)
+        slots = sorted(tick_slots)
         mask = np.zeros((1, self.C), np.float32)
         mask[0, slots] = 1.0
         self.state, _metrics = self.step(self.state, self.batch, mask)
@@ -432,6 +556,17 @@ class ServingEngine:
         if self._applies_t:
             extra["applies_t"] = np.asarray(self._applies_t)
             extra["applies_v"] = np.asarray(self._applies_v, np.int64)
+        # Slot bindings: without them a resumed engine would re-bind
+        # returning users to different slots than the uninterrupted run.
+        bind = self.binder.state()
+        extra["bind_evictions"] = bind["evictions"]
+        if bind["users"].size:
+            extra["bind_users"] = bind["users"]
+            extra["bind_slots"] = bind["slots"]
+        # Attached user store: its touched records ride the same orbax
+        # commit, so engine state and store restore atomically.
+        if self.store is not None:
+            extra.update(self.store.checkpoint_arrays())
         return save_checkpoint(directory, self.state, self.history,
                                self.tick_count, extra_meta=extra)
 
@@ -488,6 +623,13 @@ class ServingEngine:
                                np.atleast_1d(meta["pend_elig"])):
                 self.pending.append(_Pending(t=float(t), user=int(u),
                                              elig_tick=int(e)))
+        if meta.get("bind_users") is not None:
+            self.binder.restore_state(
+                np.atleast_1d(meta["bind_users"]),
+                np.atleast_1d(meta["bind_slots"]),
+                int(np.asarray(meta.get("bind_evictions", 0))))
+        if self.store is not None:
+            self.store.restore_arrays(meta)
         # Re-seed the run-total registry instruments so a post-resume
         # counters snapshot reports the whole run, not the segment.
         if self.tick_count:

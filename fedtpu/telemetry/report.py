@@ -79,6 +79,11 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     serve_last: Optional[dict] = None
     serve_summary: Optional[dict] = None
     starvation: List[dict] = []
+    cohort_rounds = 0
+    cohort_last: Optional[dict] = None
+    cohort_config: Optional[dict] = None
+    cohort_summary: Optional[dict] = None
+    cohort_stall_s = 0.0
     for e in events:
         v = e.get("v")
         if isinstance(v, int) and v > EVENT_SCHEMA_VERSION:
@@ -141,6 +146,17 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             serve_summary = {"tick": e.get("round"), **payload}
         elif kind == "async_starvation":
             starvation.append({"round": e.get("round"), **payload})
+        # Cohort timeline (fedtpu.cohort; docs/scaling.md). The summary
+        # carries the end-of-run store footprint; per-round events supply
+        # the cadence and resident-bytes trajectory when a run died early.
+        elif kind == "cohort_config":
+            cohort_config = payload
+        elif kind == "cohort_round":
+            cohort_rounds += 1
+            cohort_last = {"round": e.get("round"), **payload}
+            cohort_stall_s += float(payload.get("prefetch_stall_s") or 0.0)
+        elif kind == "cohort_summary":
+            cohort_summary = payload
 
     out: dict = {
         "events_total": len(events),
@@ -155,6 +171,7 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         "counters": {}, "gauges": {}, "histograms": {},
         "resilience": None,
         "serving": None,
+        "cohort": None,
     }
     if serve_ticks or serve_summary or starvation:
         out["serving"] = {
@@ -162,6 +179,14 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             "last_tick": serve_last,
             "summary": serve_summary,
             "starvation": starvation,
+        }
+    if cohort_rounds or cohort_config or cohort_summary:
+        out["cohort"] = {
+            "rounds": cohort_rounds,
+            "config": cohort_config,
+            "last_round": cohort_last,
+            "summary": cohort_summary,
+            "prefetch_stall_s_total": cohort_stall_s,
         }
     if manifest:
         out["manifest"] = {k: manifest.get(k) for k in
@@ -320,6 +345,32 @@ def render_text(agg: dict) -> str:
             lines.append(f"  K-BUFFER STARVATION @ tick {sv.get('round')}: "
                          f"{sv.get('pending')} buffered update(s) never "
                          f"reached buffer_size {sv.get('buffer_size')}")
+    coh = agg.get("cohort")
+    if coh:
+        lines.append("cohort:")
+        conf = coh.get("config") or {}
+        if conf:
+            lines.append(f"  config: cohort_size {conf.get('cohort_size')} "
+                         f"of {conf.get('total_clients')} clients, "
+                         f"store {conf.get('store')}, "
+                         f"sampling {conf.get('sampling')}, "
+                         f"{conf.get('cohorts_per_step')} cohort(s)/step")
+        summ = coh.get("summary") or coh.get("last_round") or {}
+        if coh.get("rounds") or summ.get("rounds"):
+            lines.append(f"  rounds: {summ.get('rounds', coh['rounds'])} "
+                         f"(touched {summ.get('touched_records', '?')} "
+                         f"client record(s))")
+        if summ.get("store_resident_bytes") is not None:
+            res_mb = summ["store_resident_bytes"] / 2**20
+            app_mb = (summ.get("store_apparent_bytes")
+                      or conf.get("store_apparent_bytes") or 0) / 2**20
+            lines.append(f"  store: resident ~{res_mb:.1f} MiB "
+                         f"(apparent {app_mb:.1f} MiB)")
+        if coh.get("prefetch_stall_s_total") or summ.get("prefetch_stalls"):
+            lines.append(f"  prefetch: {summ.get('prefetch_stalls', '?')} "
+                         f"stall(s), "
+                         f"{coh.get('prefetch_stall_s_total', 0.0):.3f} s "
+                         "stalled total")
     if agg.get("counters"):
         lines.append("counters:")
         for k, v in sorted(agg["counters"].items()):

@@ -185,6 +185,12 @@ QUICK_TESTS = {
     "test_serving.py::"
     "test_admission_check_order_is_rate_backpressure_staleness",
     "test_serving.py::test_trace_roundtrip_and_header",
+    # round-8 modules
+    # cohort subsystem (sampler + store are backend-free numpy,
+    # milliseconds; the parity/resume/RSS tests stay full-tier)
+    "test_cohort.py::test_sampler_uniform_full_population_is_identity",
+    "test_cohort.py::test_store_roundtrip_memory_and_mmap",
+    "test_cohort.py::test_cohort_config_guards",
     # test_chaos_supervised runs supervised subprocess CLI children
     # (kill + restart, ~90 s) and stays full-tier only; the in-process
     # resilience semantics are covered by test_resilience above.

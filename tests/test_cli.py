@@ -218,7 +218,7 @@ def test_every_documented_flag_exists_in_the_parser():
     for rel in ("README.md", "docs/API.md", "docs/ARCHITECTURE.md",
                 "docs/observability.md", "docs/analysis.md",
                 "docs/performance.md", "docs/resilience.md",
-                "docs/serving.md", "PARITY.md",
+                "docs/serving.md", "docs/scaling.md", "PARITY.md",
                 "benchmarks/RESULTS.md"):
         text = open(os.path.join(root, rel)).read()
         # Underscores ARE captured so `--dp_clip_norm`-style typos show up
@@ -233,6 +233,8 @@ def test_every_documented_flag_exists_in_the_parser():
                    "--socket-events",              # benchmarks/serving_bench.py
                    "--skip-socket",                # benchmarks/serving_bench.py
                    "--trace",                      # benchmarks/async_bench.py
+                   "--scale", "--total-clients",   # benchmarks/scaling.py
+                   "--store",                      # benchmarks/scaling.py
                    "--xla_force_host_platform_device_count",  # XLA flag
                    "--hostfile", "--np"}           # mpirun (reference docs)
     missing = documented - known - other_tools

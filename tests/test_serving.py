@@ -156,17 +156,35 @@ def test_engine_replay_is_bitwise_deterministic():
 
 
 def test_engine_coalesces_same_slot_arrivals():
-    """Multiple queued updates on one cohort slot ride one tick as ONE
-    arrival — tick_updates counts updates, tick_slots counts slots."""
+    """Multiple queued updates from one USER ride one tick as ONE
+    arrival — tick_updates counts updates, tick_slots counts slots.
+    (Slot coalescing is per user now: distinct users get distinct slots
+    via the binder, so only repeat arrivals from the same user share.)"""
     from fedtpu.serving.engine import ServingEngine
     eng = ServingEngine(_small_cfg(cohort=4, tick_interval_s=0.0),
                         registry=MetricsRegistry())
-    # users 0 and 4 share slot 0; user 1 is slot 1.
-    for u in (0, 4, 1):
+    # user 0 twice + user 1 once: two slots, three updates.
+    for u in (0, 0, 1):
         assert eng.offer(0.1, u, 0.0) == ACCEPT
     eng.drain()
     assert eng.history["tick_updates"][-1] == 3
     assert eng.history["tick_slots"][-1] == 2
+
+
+def test_distinct_users_never_alias_onto_one_slot():
+    """Regression for the residue-map bug the binder replaced: users 0
+    and 4 with cohort=4 used to both train slot 0 (`user % C`), silently
+    merging two client identities. Stable binding gives them distinct
+    slots while capacity lasts."""
+    from fedtpu.serving.engine import ServingEngine
+    eng = ServingEngine(_small_cfg(cohort=4, tick_interval_s=0.0),
+                        registry=MetricsRegistry())
+    for u in (0, 4):
+        assert eng.offer(0.1, u, 0.0) == ACCEPT
+    eng.drain()
+    assert eng.binder.peek(0) != eng.binder.peek(4)
+    assert eng.history["tick_updates"][-1] == 2
+    assert eng.history["tick_slots"][-1] == 2    # was 1 under `u % C`
 
 
 def test_deprioritized_updates_wait_an_extra_tick():
