@@ -19,8 +19,15 @@ Two halves:
         ``RecompileSentinel``, which counts backend compiles during
         steady-state round-stepping (after warmup that count must be 0).
 
-The lint half never imports jax; the guard half imports it lazily.  See
-docs/analysis.md for the rule catalog.
+A third, IR-level half (``fedtpu audit``; docs/analysis.md "Program
+audit"): collectives / program walk the traced jaxpr of the real round
+programs and prove the collective schedule is branch-invariant (AUD001
+otherwise), every donated buffer is realized as an alias (AUD002
+otherwise), and account per-round communication bytes — contracts
+pinned by tests/goldens/audit_*.json.
+
+The lint half never imports jax; the guard and audit halves import it
+lazily.  See docs/analysis.md for the rule catalog.
 """
 
 from fedtpu.analysis.engine import (Finding, LintResult, RULES,  # noqa: F401
@@ -32,3 +39,10 @@ from fedtpu.analysis import rules_generic, rules_jax  # noqa: F401
 from fedtpu.analysis.guards import (RecompileSentinel, RetraceError,  # noqa: F401
                                     guards)
 from fedtpu.analysis.reporters import render_json, render_text  # noqa: F401
+from fedtpu.analysis.collectives import (AuditFinding, CollectiveOp,  # noqa: F401
+                                         ScheduleResult, comm_bytes,
+                                         extract_schedule, schedule_digest)
+from fedtpu.analysis.program import (audit_preset, audit_program,  # noqa: F401
+                                     audit_step_summary, diff_audit,
+                                     donation_proof, engine_audit_spec,
+                                     render_audit_text)

@@ -1,4 +1,4 @@
-"""JAX-aware rules: FTP001-FTP004, FTP006.
+"""JAX-aware rules: FTP001-FTP004, FTP006, FTP008.
 
 All four rules hang off the same module-level reachability analysis: a
 function is *traced* if it is decorated with (or passed to) a JAX
@@ -302,6 +302,10 @@ class _KeyReuseVisitor(ast.NodeVisitor):
         self.path = path
         self.loop_depth = 0
         self.keys: dict[str, int] = {}  # name -> loop depth at assignment
+        # Consumed key identities: bare names ("k") plus constant-indexed
+        # elements of a split result ("ks[0]") — `ks = split(k, 3)` then
+        # `normal(ks[0])` twice is the same correlated-randomness bug as
+        # reusing a scalar key.
         self.used: set[str] = set()
         self.findings: list[Finding] = []
 
@@ -316,10 +320,13 @@ class _KeyReuseVisitor(ast.NodeVisitor):
         if isinstance(target, ast.Name):
             if is_key:
                 self.keys[target.id] = self.loop_depth
-                self.used.discard(target.id)
             else:
                 self.keys.pop(target.id, None)
-                self.used.discard(target.id)
+            # Rebinding invalidates the name AND every element identity
+            # derived from it (ks[0], ks[1], ...).
+            prefix = target.id + "["
+            self.used = {u for u in self.used
+                         if u != target.id and not u.startswith(prefix)}
         elif isinstance(target, (ast.Tuple, ast.List)):
             for elt in target.elts:
                 self._bind_targets(elt, is_key)
@@ -349,40 +356,56 @@ class _KeyReuseVisitor(ast.NodeVisitor):
         for stmt in node.orelse:
             self.visit(stmt)
 
+    def _key_identity(self, arg: ast.expr) -> tuple[str | None, str | None]:
+        """(identity, base name) of a key-valued argument, or (None, None).
+
+        ``k`` -> ("k", "k"); ``ks[2]`` -> ("ks[2]", "ks") when the index
+        is a constant int.  A non-constant index (``ks[i]``) is opaque —
+        each iteration may pick a different element — so it is skipped.
+        """
+        if isinstance(arg, ast.Name):
+            if arg.id in self.keys:
+                return arg.id, arg.id
+        elif isinstance(arg, ast.Subscript):
+            base = arg.value
+            if isinstance(base, ast.Name) and base.id in self.keys:
+                idx = arg.slice
+                if isinstance(idx, ast.Constant) and isinstance(idx.value, int):
+                    return f"{base.id}[{idx.value}]", base.id
+        return None, None
+
     def visit_Call(self, node: ast.Call) -> None:
         self.generic_visit(node)
-        if not _is_sampling_call(node):
+        if not _is_sampling_call(node) or not node.args:
             return
-        if not node.args or not isinstance(node.args[0], ast.Name):
+        ident, base = self._key_identity(node.args[0])
+        if ident is None:
             return
-        name = node.args[0].id
-        if name not in self.keys:
-            return
-        if name in self.used:
+        if ident in self.used:
             self.findings.append(
                 Finding(
                     rule="FTP002",
                     path=self.path,
                     line=node.lineno,
                     col=node.col_offset,
-                    message=f"PRNG key `{name}` already consumed by an earlier "
+                    message=f"PRNG key `{ident}` already consumed by an earlier "
                     "jax.random call in `"
                     f"{self.fn_name}`; split/fold_in before reusing",
                 )
             )
-        elif self.loop_depth > self.keys[name]:
+        elif self.loop_depth > self.keys[base]:
             self.findings.append(
                 Finding(
                     rule="FTP002",
                     path=self.path,
                     line=node.lineno,
                     col=node.col_offset,
-                    message=f"PRNG key `{name}` sampled inside a loop but "
+                    message=f"PRNG key `{ident}` sampled inside a loop but "
                     "created outside it; fold_in the loop index first",
                 )
             )
         else:
-            self.used.add(name)
+            self.used.add(ident)
 
 
 @rule(
@@ -756,4 +779,124 @@ def check_jit_rebuilt(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
                 message="jax.jit(f).lower(...) re-traces through a "
                 "throwaway wrapper; bind the jitted callable (or cache "
                 "the Compiled via fedtpu.compilation.ProgramCache)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# FTP008 — collective axis-name literal unbound in the module
+# ---------------------------------------------------------------------------
+
+
+# lax collectives (and axis queries) that name a mesh axis.  The axis is
+# the second positional argument except for axis_index, where it is the
+# first.
+_COLLECTIVE_FNS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+    "all_gather": 1, "ppermute": 1, "psum_scatter": 1,
+    "all_to_all": 1, "pshuffle": 1, "pswapaxes": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+# Calls that BIND axis names: mesh constructors, partition specs, and
+# shard_map itself (whose in_specs/out_specs literals name the axes the
+# body may reduce over).
+_AXIS_BINDING_CALLS = {
+    "Mesh", "AbstractMesh", "make_mesh", "make_mesh_2d",
+    "PartitionSpec", "P", "shard_map", "NamedSharding",
+}
+
+
+def _string_literals_in(node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+def _bound_axis_literals(tree: ast.AST) -> set[str]:
+    """Every axis-name string this module binds somewhere.
+
+    Three binding shapes: (a) string literals inside a mesh/spec/shard_map
+    construction call; (b) an ``axis_names=...`` keyword on any call;
+    (c) module-level axis-name constants (``CLIENTS_AXIS = "clients"`` —
+    any module-global assignment whose target mentions AXIS), which is how
+    this repo's engines share axis names across modules.
+    """
+    bound: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] in _AXIS_BINDING_CALLS:
+                for arg in node.args:
+                    bound |= _string_literals_in(arg)
+                for kw in node.keywords:
+                    bound |= _string_literals_in(kw.value)
+            else:
+                for kw in node.keywords:
+                    if kw.arg in {"axis_names", "mesh_axes"}:
+                        bound |= _string_literals_in(kw.value)
+    if isinstance(tree, ast.Module):
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign):
+                if any(
+                    isinstance(t, ast.Name) and "AXIS" in t.id.upper()
+                    for t in stmt.targets
+                ):
+                    bound |= _string_literals_in(stmt.value)
+    return bound
+
+
+def _collective_axis_literals(call: ast.Call) -> list[ast.Constant]:
+    """String-literal axis names a collective call passes, if any."""
+    chain = _attr_chain(call.func)
+    if not chain or chain[-1] not in _COLLECTIVE_FNS:
+        return []
+    if len(chain) > 1 and chain[0] not in {"jax", "lax"}:
+        return []
+    axis_expr: ast.expr | None = None
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            axis_expr = kw.value
+    if axis_expr is None:
+        pos = _COLLECTIVE_FNS[chain[-1]]
+        if pos < len(call.args):
+            axis_expr = call.args[pos]
+    if axis_expr is None:
+        return []
+    exprs = (list(axis_expr.elts)
+             if isinstance(axis_expr, (ast.Tuple, ast.List))
+             else [axis_expr])
+    return [e for e in exprs
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+
+
+@rule(
+    "FTP008",
+    "unbound-collective-axis",
+    "A lax collective whose axis-name string literal is not bound by any "
+    "Mesh/shard_map/PartitionSpec (or *_AXIS constant) in the same module "
+    "— the psum compiles fine under tests that happen to bind that axis "
+    "and dies with 'unbound axis name' under any other mesh.",
+)
+def check_unbound_collective_axis(
+    tree: ast.AST, src: str, path: str
+) -> Iterable[Finding]:
+    bound = _bound_axis_literals(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for lit in _collective_axis_literals(node):
+            if lit.value in bound:
+                continue
+            fn = _attr_chain(node.func)[-1]
+            yield Finding(
+                rule="FTP008",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=f"collective `{fn}` names axis '{lit.value}' but "
+                "nothing in this module binds it (no Mesh/shard_map/"
+                "PartitionSpec literal, no *_AXIS constant); import the "
+                "engine's axis constant instead of retyping the string",
             )

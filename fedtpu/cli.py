@@ -634,6 +634,44 @@ def build_parser() -> argparse.ArgumentParser:
                               "before building, so the retrace gate also "
                               "validates warm-cache startup (pair with "
                               "'fedtpu warmup --cache DIR')")
+    check_p.add_argument("--audit", action="store_true",
+                         help="also run the static side — the AST lint "
+                              "over the package plus the jaxpr-level "
+                              "program audit ('fedtpu audit') of the same "
+                              "preset — folded into the exit code")
+
+    # IR-level program audit: trace the real engines, extract and verify
+    # the collective schedule, prove donation, account comm bytes
+    # (docs/analysis.md "Program audit").
+    audit_p = sub.add_parser("audit",
+                             help="jaxpr-level SPMD program audit: "
+                                  "collective schedule, donation proof, "
+                                  "comm-byte contract")
+    audit_p.add_argument("preset", nargs="?", default="income-8",
+                         choices=sorted(PRESETS))
+    audit_p.add_argument("--format", choices=["text", "json"],
+                         default="text",
+                         help="contract rendering (default text)")
+    audit_p.add_argument("--engines", default=None, metavar="E[,E...]",
+                         help="comma-separated engines to audit "
+                              "(sync,async,tp,cohort; default all)")
+    audit_p.add_argument("--synthetic-rows", type=_positive_int, default=512,
+                         help="synthetic dataset size (the audit traces "
+                              "programs, it never steps them)")
+    audit_p.add_argument("--platform", choices=["default", "cpu"],
+                         default="default",
+                         help="force the JAX platform before backend init")
+    audit_p.add_argument("--host-devices", type=_positive_int, default=None,
+                         metavar="N",
+                         help="force N virtual host CPU devices (XLA flag; "
+                              "applied before backend init — required for "
+                              "the tp engine on single-device hosts)")
+    audit_p.add_argument("--golden", default=None, metavar="PATH",
+                         help="diff the live contract against this golden "
+                              "JSON; any mismatch fails the audit")
+    audit_p.add_argument("--write-golden", default=None, metavar="PATH",
+                         help="write the JSON contract to PATH "
+                              "(golden (re)generation)")
 
     # AOT pre-compilation: populate a persistent cache with a preset's
     # program family so later runs/sweeps start warm (docs/performance.md).
@@ -1034,6 +1072,14 @@ def main(argv=None) -> int:
                          heartbeat=args.heartbeat, events=args.events,
                          verbose=not args.quiet)
 
+    if getattr(args, "host_devices", None):
+        # Before ANY backend touch: XLA only reads this flag at backend
+        # init, so it must land in the environment first.
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            (flags + " " if flags else "")
+            + f"--xla_force_host_platform_device_count={args.host_devices}")
+
     if getattr(args, "platform", "default") == "cpu":
         # Before ANY backend touch (including the compilation-cache config
         # below, which imports jax): pin the CPU platform for the whole
@@ -1090,14 +1136,77 @@ def main(argv=None) -> int:
                            nans=args.debug_nans,
                            synthetic_rows=args.synthetic_rows,
                            warmup_cache=args.warmup_cache)
+        if args.audit:
+            # --audit = the full static side alongside the runtime probe:
+            # the AST lint over the package plus the IR-level program
+            # audit of the same preset, all folded into one exit code.
+            from fedtpu.analysis.engine import lint_paths
+            from fedtpu.analysis.program import audit_preset
+            pkg_dir = os.path.dirname(os.path.abspath(__file__))
+            lint_res = lint_paths([pkg_dir])
+            report["lint"] = {"clean": not lint_res.findings,
+                             "findings": len(lint_res.findings)}
+            audit = audit_preset(args.preset,
+                                 synthetic_rows=args.synthetic_rows)
+            report["audit"] = {
+                "ok": audit["ok"],
+                "findings": audit["findings"],
+                "digests": {
+                    name: c.get("schedule_digest")
+                    for name, c in audit["engines"].items()},
+            }
+            report["ok"] = (report["ok"] and audit["ok"]
+                            and report["lint"]["clean"])
         if args.json:
             print(json.dumps(report))
         else:
             for key in ("preset", "backend", "device_count", "rounds",
                         "transfer_guard", "debug_nans", "warmup_cache",
-                        "sentinel_available", "recompiles", "ok"):
+                        "sentinel_available", "recompiles"):
                 print(f"{key}: {report[key]}")
+            if "lint" in report:
+                print(f"lint: clean={report['lint']['clean']} "
+                      f"findings={report['lint']['findings']}")
+            if "audit" in report:
+                print(f"audit: ok={report['audit']['ok']} "
+                      f"digests={report['audit']['digests']}")
+            print(f"ok: {report['ok']}")
         return 0 if report["ok"] else 1
+
+    if args.cmd == "audit":
+        # Before _apply_overrides: the audit traces the preset's program
+        # family as configured — it carries only its own flag set.
+        from fedtpu.analysis.program import (audit_preset, diff_audit,
+                                             render_audit_text)
+        engines = ([e.strip() for e in args.engines.split(",") if e.strip()]
+                   if args.engines else None)
+        report = audit_preset(args.preset, engines=engines,
+                              synthetic_rows=args.synthetic_rows)
+        ok = report["ok"]
+        if args.write_golden:
+            with open(args.write_golden, "w", encoding="utf-8") as fh:
+                json.dump(report, fh, indent=2, sort_keys=True)
+                fh.write("\n")
+        if args.golden:
+            with open(args.golden, encoding="utf-8") as fh:
+                golden = json.load(fh)
+            mismatches = diff_audit(report, golden)
+            ok = ok and not mismatches
+        if args.format == "json":
+            print(json.dumps(report, sort_keys=True))
+            if args.golden and mismatches:
+                print(json.dumps({"golden_mismatches": mismatches}))
+        else:
+            print(render_audit_text(report))
+            if args.golden:
+                if mismatches:
+                    print(f"golden: {len(mismatches)} mismatch(es) "
+                          f"vs {args.golden}")
+                    for m in mismatches:
+                        print(f"  {m}")
+                else:
+                    print(f"golden: matches {args.golden}")
+        return 0 if ok else 1
 
     if args.cmd == "serve":
         # Before _apply_overrides: serve carries its own ServingConfig

@@ -60,6 +60,19 @@ from fedtpu.parallel.ring import make_all_reduce
 from fedtpu.parallel.round import bcast_global, client_init_keys
 from fedtpu.training.client import make_local_eval_step, make_local_train_step
 
+# Read-only audit hook (fedtpu.analysis.program): the scan-over-cohorts
+# chunk donates BOTH the carry state and the streamed xs buffers.
+AUDIT_SPEC = {
+    "engine": "cohort",
+    "builder": "build_cohort_round_fn",
+    "donate_argnums": (0, 1),
+    # xs (arg 1) is donated to FREE the streamed chunk, not to alias it:
+    # the prefetcher allocates the next chunk fresh, so no output exists
+    # for x/y/mask to alias into.  Only state (arg 0) must round-trip.
+    "alias_expected": (0,),
+    "collective_axes": (CLIENTS_AXIS,),
+}
+
 SAMPLING_POLICIES = ("uniform", "weighted", "trace")
 
 

@@ -739,6 +739,20 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 "cached": bool(overlap_cache
                                and overlap_cache.peek(overlap_key)),
             }
+        try:
+            # Trace-only program audit of the canonical width-1 round:
+            # schedule digest + per-round comm bytes pin WHAT this run
+            # communicated (docs/analysis.md "Program audit"); no compile,
+            # no donation proof here — 'fedtpu audit' carries the proofs.
+            from fedtpu.analysis.program import (audit_step_summary,
+                                                 engine_audit_spec)
+            manifest_extra["audit"] = dict(
+                audit_step_summary(exp.make_step(1), (state, batch)),
+                engine=engine_audit_spec(cfg)["engine"])
+        except Exception as exc:
+            # The audit is diagnostic metadata; a trace failure must not
+            # take down the run it describes.
+            manifest_extra["audit"] = {"error": str(exc)}
         tracer.event("manifest", **build_manifest(
             cfg=cfg, mesh=exp.mesh, extra=manifest_extra))
     # Estimated exchange volume per round: every client ships one model's

@@ -57,6 +57,15 @@ from fedtpu.parallel.round import (assemble_metrics, bcast_global,
 from fedtpu.training.client import (make_local_eval_step,
                                     make_local_train_step)
 
+# Read-only audit hook (fedtpu.analysis.program): the FedBuff tick's
+# traced entry point + donation contract, consumed by the SPMD auditor.
+AUDIT_SPEC = {
+    "engine": "async",
+    "builder": "build_async_round_fn",
+    "donate_argnums": (0,),
+    "collective_axes": (CLIENTS_AXIS,),
+}
+
 
 def record_tick_telemetry(registry, tracer, tick: int, staleness) -> None:
     """Fold one tick's (C,) staleness vector into the metrics registry

@@ -47,6 +47,16 @@ from fedtpu.parallel.mesh import CLIENTS_AXIS, client_sharding
 from fedtpu.parallel.ring import make_all_reduce
 from fedtpu.training.client import make_local_train_step, make_local_eval_step
 
+# Read-only audit hook (fedtpu.analysis.program): names this engine's
+# traced entry point and the donation contract its builder applies, so
+# the SPMD auditor / manifest wiring never hardcode engine internals.
+AUDIT_SPEC = {
+    "engine": "sync",
+    "builder": "build_round_fn",
+    "donate_argnums": (0,),
+    "collective_axes": (CLIENTS_AXIS,),
+}
+
 
 # PRNG domain-separation tag for the DP noise stream (vs the participation
 # stream, which folds the round index directly into key(participation_seed)).

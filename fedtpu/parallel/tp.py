@@ -45,6 +45,16 @@ from fedtpu.training.client import (make_local_eval_step,
 
 MODEL_AXIS = "model"
 
+# Read-only audit hook (fedtpu.analysis.program). This engine's
+# collectives are GSPMD-chosen after partitioning, so the auditor pairs
+# the (collective-free) jaxpr walk with a compiled-HLO census here.
+AUDIT_SPEC = {
+    "engine": "tp",
+    "builder": "build_round_fn_2d",
+    "donate_argnums": (0,),
+    "collective_axes": (CLIENTS_AXIS, MODEL_AXIS),
+}
+
 
 def drop_client_axis(spec: P) -> P:
     """The per-leaf layout of a GLOBAL (clients-free) tensor: the same spec

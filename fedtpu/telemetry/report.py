@@ -172,6 +172,7 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         "resilience": None,
         "serving": None,
         "cohort": None,
+        "static_analysis": None,
     }
     if serve_ticks or serve_summary or starvation:
         out["serving"] = {
@@ -195,6 +196,10 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
                             "mesh_shape", "git_rev", "process_count",
                             "program", "engine", "restarts", "fault_plan")
                            if manifest.get(k) is not None}
+        # The run's program-audit stamp (orchestration/loop.py manifest
+        # wiring): schedule digest + comm bytes of the width-1 round.
+        if manifest.get("audit"):
+            out["static_analysis"] = manifest["audit"]
     if (faults or rollbacks or exclusions or restarts or gang_restarts
             or collective_hangs or child_exits or preempted_rounds
             or resume_rounds or diverged_at or supervisor_exit):
@@ -246,6 +251,17 @@ def render_text(agg: dict) -> str:
     if man:
         lines.append("  manifest: " + ", ".join(
             f"{k}={man[k]}" for k in sorted(man)))
+    sa = agg.get("static_analysis")
+    if sa:
+        if "error" in sa:
+            lines.append(f"static analysis: audit failed ({sa['error']})")
+        else:
+            lines.append(
+                f"static analysis: engine={sa.get('engine')} "
+                f"schedule={sa.get('schedule_digest')} "
+                f"collectives={sa.get('collectives')} "
+                f"comm={sa.get('comm_bytes_per_round')}B/round "
+                f"findings={sa.get('findings')}")
     ph = agg.get("phases") or {}
     if ph:
         lines.append("phase breakdown:")

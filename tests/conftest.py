@@ -115,6 +115,10 @@ QUICK_TESTS = {
     "test_pallas.py::test_weighted_average_kernel_matches_numpy",
     "test_parity.py::test_limitation_demonstrated",
     "test_participation.py::test_sampled_average_over_participants_only",
+    "test_program_audit.py::test_extract_schedule_counts_psum_bytes",
+    "test_program_audit.py::test_branch_divergent_schedule_flags_aud001",
+    "test_program_audit.py::test_donation_proof_flags_unaliased_aud002",
+    "test_audit_gate.py::test_goldens_are_clean_contracts",
     "test_personalize.py::test_personalize_rejects_zero_steps",
     "test_pipelined_stop.py::test_pipelined_divergence_still_halts",
     "test_privacy_ledger.py::test_checkpoint_meta_roundtrips_exactly",

@@ -205,6 +205,26 @@ FIXTURES = {
                 os._exit(7)  # fedtpu: noqa[FTP007] fixture
             """,
     },
+    "FTP008": {
+        "positive": """
+            import jax
+            def agg(x):
+                return jax.lax.psum(x, "clients")
+            """,
+        "negative": """
+            import jax
+            CLIENTS_AXIS = "clients"
+            def agg(x):
+                return jax.lax.psum(x, "clients")
+            def agg2(x, axis):
+                return jax.lax.psum(x, axis)   # Name-valued axis: skipped
+            """,
+        "suppressed": """
+            import jax
+            def agg(x):
+                return jax.lax.psum(x, "clients")  # fedtpu: noqa[FTP008] fixture
+            """,
+    },
     "FTP101": {
         "positive": """
             def f(xs=[]):
@@ -277,6 +297,52 @@ def test_rule_fixtures_catch_seeded_violations():
 
 
 # --------------------------------------------------------- engine semantics
+def test_ftp002_tuple_unpack_reuse():
+    """Keys bound by tuple-unpacking a split are tracked individually:
+    reusing one element is the same bug as reusing a scalar key."""
+    src = """
+        import jax
+        def f(k):
+            k1, k2 = jax.random.split(k)
+            a = jax.random.normal(k1, (3,))
+            b = jax.random.uniform(k1, (3,))
+            return a + b
+    """
+    assert codes(src) == ["FTP002"]
+    clean = """
+        import jax
+        def f(k):
+            k1, k2 = jax.random.split(k)
+            return jax.random.normal(k1) + jax.random.uniform(k2)
+    """
+    assert codes(clean) == []
+
+
+def test_ftp002_indexed_split_reuse():
+    """Constant-indexed elements of a split result (`ks[0]`) are key
+    identities; a dynamic index (`ks[i]`) is opaque and never flagged,
+    and rebinding the array resets every derived identity."""
+    src = """
+        import jax
+        def f(k):
+            ks = jax.random.split(k, 3)
+            a = jax.random.normal(ks[0])
+            b = jax.random.uniform(ks[0])
+            return a + b
+    """
+    assert codes(src) == ["FTP002"]
+    clean = """
+        import jax
+        def f(k, i):
+            ks = jax.random.split(k, 3)
+            a = jax.random.normal(ks[0]) + jax.random.uniform(ks[1])
+            b = jax.random.normal(ks[i]) + jax.random.uniform(ks[i])
+            ks = jax.random.split(ks[2], 3)
+            return a + b + jax.random.normal(ks[0])
+    """
+    assert codes(clean) == []
+
+
 def test_select_and_ignore_filters():
     src = FIXTURES["FTP005"]["positive"]
     assert codes(src, select=["FTP005"]) == ["FTP005"]

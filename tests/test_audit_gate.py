@@ -1,0 +1,64 @@
+"""The tier-1 program-audit gate: `fedtpu audit <preset> --golden ...`.
+
+Mirrors test_lint_gate.py one layer down: where the lint gate keeps the
+AST clean, this gate pins the compiled truth — the collective schedule
+(op/axis/bytes/trips per engine), the donation tables, and the
+post-SPMD HLO collective census — of every engine on the canonical
+income presets against committed goldens.  Any PR that adds a psum,
+drops a donation, or perturbs the GSPMD partitioning shows up as a
+golden diff here, in the ordinary `-m 'not slow'` flow.
+
+The goldens were generated under this suite's hermetic env (CPU
+backend, 8 virtual devices — tests/conftest.py) via:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m fedtpu.cli audit <preset> --synthetic-rows 256 \
+        --write-golden tests/goldens/audit_<preset>.json
+
+Regenerate the same way after an INTENDED schedule/donation change and
+review the diff like any other golden.
+"""
+
+import json
+import os
+
+import pytest
+
+from fedtpu.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDENS = os.path.join(REPO, "tests", "goldens")
+PRESETS = ("income-2", "income-8")
+
+
+def _golden_path(preset):
+    return os.path.join(GOLDENS, f"audit_{preset}.json")
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_audit_matches_committed_golden(preset, capsys):
+    rc = cli_main(["audit", preset, "--synthetic-rows", "256",
+                   "--golden", _golden_path(preset)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"fedtpu audit diverged from its golden:\n{out}"
+    assert f"golden: matches {_golden_path(preset)}" in out
+
+
+def test_goldens_are_clean_contracts():
+    """The committed contracts themselves: no findings, every engine
+    present (none silently skipped), and non-trivial schedules — guards
+    against regenerating a golden from a degraded environment."""
+    for preset in PRESETS:
+        with open(_golden_path(preset), encoding="utf-8") as fh:
+            golden = json.load(fh)
+        assert golden["ok"] and golden["findings"] == [], preset
+        assert set(golden["engines"]) == {"sync", "async", "tp", "cohort"}
+        for name, contract in golden["engines"].items():
+            assert "skipped" not in contract, (preset, name)
+        assert golden["engines"]["sync"]["comm_bytes_per_round"] > 0
+        # GSPMD engine: schedule lives in the HLO census, not the jaxpr.
+        assert golden["engines"]["tp"]["schedule"] == []
+        assert golden["engines"]["tp"]["hlo_collectives"]
+        # Cohort/sync parity is a design invariant, pinned here too.
+        assert (golden["engines"]["cohort"]["schedule_digest"]
+                == golden["engines"]["sync"]["schedule_digest"])
