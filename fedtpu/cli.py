@@ -220,6 +220,12 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         shard = dataclasses.replace(shard, num_clients=args.num_clients)
     if args.shard_strategy is not None:
         shard = dataclasses.replace(shard, strategy=args.shard_strategy)
+    if getattr(args, "partition_clients", None) is not None:
+        shard = dataclasses.replace(shard,
+                                    partition_clients=args.partition_clients)
+    if getattr(args, "partition_offset", None) is not None:
+        shard = dataclasses.replace(shard,
+                                    partition_offset=args.partition_offset)
     if args.hidden_sizes is not None:
         model = dataclasses.replace(model, hidden_sizes=args.hidden_sizes)
     if args.compute_dtype is not None:
@@ -398,6 +404,19 @@ def build_parser() -> argparse.ArgumentParser:
                        help=">1 selects the 2-D ('clients','model') GSPMD "
                             "engine: hidden weights shard over a tensor-"
                             "parallel axis of this extent (MLP only)")
+    # run-only: the elastic-reshard partition window (docs/resilience.md).
+    # A shrunk gang trains --num-clients C as the contiguous window
+    # [offset, offset+C) of a P-client partition, so its shards stay
+    # bitwise identical to the pre-shrink full-width run's.
+    run_p.add_argument("--partition-clients", type=int, default=None,
+                       help="shard the dataset as if for this many clients "
+                            "and keep only the --num-clients window "
+                            "starting at --partition-offset (elastic-"
+                            "reshard data layout; default: no window)")
+    run_p.add_argument("--partition-offset", type=_nonnegative_int,
+                       default=None,
+                       help="first global client row of the partition "
+                            "window (requires --partition-clients)")
     # run-only, like --aggregation: the sweep/parity programs have their
     # own init and stop semantics; accepting these there would silently
     # ignore them.
@@ -733,6 +752,12 @@ def build_parser() -> argparse.ArgumentParser:
     sup_p.add_argument("--grace", type=_nonnegative_float, default=15.0,
                        help="seconds a SIGTERM'd child gets to drain its "
                             "checkpoint before SIGKILL (default 15)")
+    sup_p.add_argument("--healthy-window", type=_nonnegative_float,
+                       default=300.0,
+                       help="a child/gang that stays up this many seconds "
+                            "is considered healthy again: the crash "
+                            "streak driving exponential backoff resets "
+                            "(default 300; 0 never resets)")
     sup_p.add_argument("--hang-timeout", type=_nonnegative_float,
                        default=None,
                        help="SIGKILL + restart the child when its "
@@ -1010,12 +1035,14 @@ def main(argv=None) -> int:
                                   hang_timeout=args.hang_timeout,
                                   heartbeat=args.heartbeat,
                                   events=args.events,
+                                  healthy_window=args.healthy_window,
                                   verbose=not args.quiet)
         return supervise(child, max_restarts=args.max_restarts,
                          backoff_base=args.backoff,
                          backoff_max=args.backoff_max,
                          grace=args.grace, hang_timeout=args.hang_timeout,
                          heartbeat=args.heartbeat, events=args.events,
+                         healthy_window=args.healthy_window,
                          verbose=not args.quiet)
 
     if args.cmd == "chaos":

@@ -81,6 +81,14 @@ class ShardConfig:
     unseeded_per_client_bug: bool = False
     strategy: str = "contiguous"         # 'contiguous' | 'label_sort' | 'dirichlet'
     dirichlet_alpha: float = 0.5         # label-skew strength for 'dirichlet'
+    # Partition view for elastic-reshard verification (docs/resilience.md):
+    # > 0 shards the data as if partition_clients clients existed, then keeps
+    # only rows [partition_offset, partition_offset + num_clients). A run at
+    # the post-shrink topology under these flags sees bitwise the SAME
+    # per-client rows (padding included) as the survivors of a live reshard
+    # from partition_clients down to num_clients. 0 = off (shard normally).
+    partition_clients: int = 0
+    partition_offset: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -451,6 +459,15 @@ PRESETS = {
     "income-8": ExperimentConfig(
         data=_income_data(),
         shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=300),
+    ),
+    # 2b: the shrink target of income-8 — the topology a live reshard lands
+    # on when income-8 loses half its mesh. Audited/goldened alongside its
+    # parent so a reshard can never silently change the collective schedule
+    # (tests/test_audit_gate.py).
+    "income-4": ExperimentConfig(
+        data=_income_data(),
+        shard=ShardConfig(num_clients=4),
         fed=FedConfig(rounds=300),
     ),
     # 3: sklearn MLPClassifier warm-start parity path (FL_SkLearn...),

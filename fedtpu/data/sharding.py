@@ -58,8 +58,29 @@ def _contiguous_bounds(num_samples: int, num_clients: int):
     return bounds
 
 
+def _partition_view(cfg: ShardConfig):
+    """Resolve the elastic-verification partition window (config.py): shard
+    as-if ``partition_clients`` clients exist, keep the ``num_clients``-wide
+    window at ``partition_offset``. Returns (full_cfg, offset) — full_cfg is
+    the as-if config with the window fields cleared, or None when off."""
+    if cfg.partition_clients <= 0:
+        return None, 0
+    if not (0 <= cfg.partition_offset
+            and cfg.partition_offset + cfg.num_clients <= cfg.partition_clients):
+        raise ValueError(
+            f"partition window [{cfg.partition_offset}, "
+            f"{cfg.partition_offset + cfg.num_clients}) exceeds "
+            f"partition_clients={cfg.partition_clients}")
+    full = dataclasses.replace(cfg, num_clients=cfg.partition_clients,
+                               partition_clients=0, partition_offset=0)
+    return full, cfg.partition_offset
+
+
 def shard_indices(y: np.ndarray, cfg: ShardConfig) -> List[np.ndarray]:
     """Return per-client index arrays into the train set."""
+    full, offset = _partition_view(cfg)
+    if full is not None:
+        return shard_indices(y, full)[offset:offset + cfg.num_clients]
     n = len(y)
     c = cfg.num_clients
     rng = np.random.default_rng(cfg.shard_seed)
@@ -107,9 +128,20 @@ def pack_clients(x: np.ndarray, y: np.ndarray, cfg: ShardConfig,
 
     ``pad_multiple`` rounds the per-client sample axis up so its size stays
     friendly to XLA tiling (the 8-sublane dimension on TPU).
+
+    Under a partition window (``partition_clients``, see ShardConfig) the
+    pad length is computed over ALL partition shards — not just the kept
+    window — so every kept row is bitwise-identical (padding included) to
+    the corresponding row of the full pack.
     """
-    idx = shard_indices(y, cfg)
-    max_n = max((len(i) for i in idx), default=0)
+    full, offset = _partition_view(cfg)
+    if full is not None:
+        idx_all = shard_indices(y, full)
+        idx = idx_all[offset:offset + cfg.num_clients]
+        max_n = max((len(i) for i in idx_all), default=0)
+    else:
+        idx = shard_indices(y, cfg)
+        max_n = max((len(i) for i in idx), default=0)
     max_n = max(1, -(-max_n // pad_multiple) * pad_multiple)
 
     feat_shape = x.shape[1:]

@@ -14,9 +14,11 @@ optax namedtuple nodes come back as namedtuples, not dicts.
 
 from __future__ import annotations
 
+import itertools
 import os
 import shutil
 import warnings
+from collections import defaultdict
 from typing import Optional, Tuple
 
 import jax
@@ -44,8 +46,59 @@ def _strip_marker(state):
     return state
 
 
+# Per-process attempt ordinal per checkpoint step — see
+# _sync_orbax_barrier_counters.
+_SAVE_ATTEMPTS: dict = defaultdict(itertools.count)
+
+
+def _sync_orbax_barrier_counters(step: int) -> None:
+    """Orbax derives collective barrier names from PROCESS-LOCAL monotonic
+    counters (orbax.checkpoint.multihost.counters). After a live shrink
+    (fedtpu.resilience.reshard) the survivors checkpoint alone while the
+    parked member's counters stand still, so the first post-grow full-gang
+    save would barrier under mismatched names — an AssertionError on the
+    sync_global_devices path, a timeout on the KV-barrier path. Every
+    member of a save group calls save_checkpoint together, so resetting
+    the counters to a base derived from (step, per-step attempt) — both
+    symmetric across the group — restores the equal-names invariant orbax
+    assumes, while keeping names unique across rounds and across repeated
+    same-round saves."""
+    if jax.process_count() == 1:
+        return
+    from orbax.checkpoint.multihost import counters as _counters
+    attempt = next(_SAVE_ATTEMPTS[step])
+    base = (step + 1) * 10_000 + attempt * 100
+    for name in ("_async_save_counter", "_composite_save_counter",
+                 "_tmp_directory_counter"):
+        setattr(_counters, name, itertools.count(base))
+
+
+def _checkpointer(step: int, process_group=None) -> ocp.Checkpointer:
+    """A PyTree checkpointer scoped to ``process_group`` (process indices)
+    when given. After a live shrink (fedtpu.resilience.reshard) the
+    departed member is parked outside every collective, so orbax's default
+    all-process barrier would hang; the group-scoped checkpointer barriers
+    only the survivors, with the lowest survivor as primary host. The
+    barrier key prefix is derived from (group, step) so concurrent saves
+    of different rounds never alias."""
+    if process_group is None or jax.process_count() == 1:
+        return ocp.PyTreeCheckpointer()
+    group = sorted(int(p) for p in process_group)
+    mp_opts = ocp.options.MultiprocessingOptions(
+        primary_host=group[0],
+        active_processes=set(group),
+        barrier_sync_key_prefix=f"fedtpu_g{group[0]}x{len(group)}s{step}")
+    # The handler holds its OWN barrier options (defaulting to every
+    # process) — scoping only the Checkpointer leaves the handler's
+    # internal save barrier waiting on the parked member forever.
+    return ocp.Checkpointer(
+        ocp.PyTreeCheckpointHandler(multiprocessing_options=mp_opts),
+        multiprocessing_options=mp_opts)
+
+
 def save_checkpoint(directory: str, state, history: dict, step: int,
-                    extra_meta: Optional[dict] = None) -> str:
+                    extra_meta: Optional[dict] = None,
+                    process_group=None) -> str:
     """Write state + {history, step, num_clients, **extra_meta} under
     ``directory/round_<step>``. ``num_clients`` lives in the tiny meta item
     so elastic-resume detection (fedtpu.orchestration.loop) never has to
@@ -60,12 +113,31 @@ def save_checkpoint(directory: str, state, history: dict, step: int,
     deadlocks the job). The state is passed through as jax.Arrays so orbax
     writes each client shard from the process that owns it (distributed
     checkpointing over the shared checkpoint filesystem); single-process
-    keeps the simple host-numpy path."""
+    keeps the simple host-numpy path.
+
+    ``process_group``: after a live shrink, the surviving process indices —
+    every member of the group (and ONLY the group) must make this call;
+    see ``_checkpointer``."""
     path = _ckpt_path(directory, step)
-    ckptr = ocp.PyTreeCheckpointer()
+    _sync_orbax_barrier_counters(step)
+    ckptr = _checkpointer(step, process_group)
     state_item = _strip_marker(state)
     if jax.process_count() == 1:
         state_item = to_numpy(state_item)
+    else:
+        # After a live shrink the surviving group may hold the WHOLE state
+        # (every leaf fully addressable) while jax.process_count() still
+        # reports the original gang — jax's array serialization refuses
+        # fully-addressable arrays under multiprocess ("Cannot serialize
+        # host local arrays"). Route such leaves through the host-numpy
+        # path; the scoped checkpointer's primary is the only writer, so
+        # the on-disk checkpoint is equivalent. Full-gang saves never
+        # match (client-sharded and gang-replicated leaves are not fully
+        # addressable from any one process), so their path is unchanged.
+        state_item = jax.tree.map(
+            lambda l: np.asarray(l)
+            if isinstance(l, jax.Array) and l.is_fully_addressable else l,
+            state_item)
     ckptr.save(os.path.join(path, "state"), state_item, force=True)
     num_clients = jax.tree.leaves(state["params"])[0].shape[0]
     # Engine kind as an int flag (orbax meta passes through np.asarray, so

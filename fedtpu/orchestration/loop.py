@@ -231,8 +231,15 @@ class Experiment:
 
 
 def build_experiment(cfg: ExperimentConfig,
-                     dataset: Optional[Dataset] = None) -> Experiment:
-    """Wire data -> mesh -> model -> optimizer -> compiled round factory."""
+                     dataset: Optional[Dataset] = None,
+                     mesh: Optional[object] = None) -> Experiment:
+    """Wire data -> mesh -> model -> optimizer -> compiled round factory.
+
+    ``mesh``: explicit ('clients',) mesh to build on instead of the
+    process-local default from ``make_mesh``. A live reshard
+    (fedtpu.resilience.reshard) passes the agreed post-shrink submesh here —
+    under jax.distributed the default would re-enroll every process,
+    including the departing one."""
     ds = dataset if dataset is not None else load_dataset(cfg.data)
     model_cfg = cfg.model
     if model_cfg.kind == "mlp" and model_cfg.input_dim != ds.input_dim:
@@ -315,7 +322,8 @@ def build_experiment(cfg: ExperimentConfig,
             raise ValueError("async_mode uses the psum aggregation path "
                              "only")
         from fedtpu.parallel import async_fed
-        mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
+        if mesh is None:
+            mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
         shard = client_sharding(mesh)
         state_fn = lambda: async_fed.init_async_state(
             jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
@@ -372,6 +380,10 @@ def build_experiment(cfg: ExperimentConfig,
                 f"sharded dims {bad} not divisible by "
                 f"model_parallel={cfg.run.model_parallel}; uneven shards "
                 "would silently pad and imbalance memory/compute")
+        if mesh is not None:
+            raise ValueError("build_experiment(mesh=...) supports the 1-D "
+                             "engines only (elastic reshard does not cover "
+                             "model_parallel > 1)")
         mesh = tp.make_mesh_2d(cfg.run.model_parallel, cfg.shard.num_clients,
                                cfg.run.mesh_devices)
         shard = tp.batch_sharding_2d(mesh)
@@ -387,7 +399,8 @@ def build_experiment(cfg: ExperimentConfig,
             dp_noise_multiplier=cfg.fed.dp_noise_multiplier,
             dp_seed=cfg.fed.dp_seed)
     else:
-        mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
+        if mesh is None:
+            mesh = make_mesh(cfg.run.mesh_devices, cfg.shard.num_clients)
         shard = client_sharding(mesh)
         state_fn = lambda: init_federated_state(
             jax.random.key(cfg.fed.init_seed), mesh, cfg.shard.num_clients,
@@ -657,6 +670,53 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                             restarts=restart_count)
 
     _beat("starting", 0)
+
+    # Elastic live reshard (fedtpu.resilience.reshard; docs/resilience.md
+    # "Elastic resharding"): a preemption NOTICE — SIGUSR1/SIGUSR2
+    # forwarded by the gang supervisor, or a preempt_notice/preempt_cancel
+    # fault-plan entry — resizes the gang at a round boundary instead of
+    # tearing it down. 1-D engines only; the lockstep protocol needs
+    # width-1 chunks and the synchronous stop path, so a SIGNAL under any
+    # other config degrades to the ordinary SIGTERM drain in the loop (a
+    # PLAN entry under such a config is a startup error instead — the plan
+    # promised an exact-round reshard the config cannot deliver).
+    reshard_ctl = None
+    reshard_stack: List[dict] = []     # pre-shrink bindings, for grow-back
+    ckpt_group = None                  # surviving processes after a shrink
+    reshard_live = (max(1, cfg.run.rounds_per_step) == 1
+                    and not cfg.run.pipelined_stop)
+    if cfg.run.model_parallel == 1:
+        from fedtpu.resilience.distributed import ENV_LAUNCH_ID
+        from fedtpu.resilience.reshard import (ReshardController,
+                                               ReshardFailed)
+        reshard_ctl = ReshardController(
+            plan=injector.plan if injector is not None else None,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            launch_id=os.environ.get(ENV_LAUNCH_ID) or None,
+            restart_count=restart_count,
+            checkpoint_dir=cfg.run.checkpoint_dir or None,
+            ack_timeout=cfg.run.collective_timeout or 60.0,
+            tracer=tracer, registry=registry,
+            heartbeat=cfg.run.heartbeat_file or None)
+        reshard_ctl.install_signal_handlers()
+    if injector is not None:
+        from fedtpu.resilience.faults import RESHARD_KINDS
+        if any(f.kind in RESHARD_KINDS for f in injector.plan.faults):
+            if reshard_ctl is None:
+                raise ValueError("preempt_notice/preempt_cancel faults "
+                                 "require the 1-D engines "
+                                 "(model_parallel=1)")
+            if not reshard_live:
+                raise ValueError("preempt_notice/preempt_cancel faults "
+                                 "require rounds_per_step=1 and "
+                                 "pipelined_stop off: the reshard fires at "
+                                 "an exact round boundary")
+            if multiproc and not cfg.run.checkpoint_dir:
+                raise ValueError("multi-process elastic reshard needs "
+                                 "checkpoint_dir: the commit barrier and "
+                                 "grow spool live under "
+                                 "<checkpoint_dir>/.reshard")
 
     # Collective watchdog: armed only around the loop's BLOCKING windows
     # (warm round dispatch, chunk metric fetch, held-out-eval fetch,
@@ -1061,7 +1121,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             save_checkpoint(
                 os.path.join(cfg.run.checkpoint_dir, "diverged"),
                 state, history, label_round,
-                extra_meta=ledger.checkpoint_meta(label_round))
+                extra_meta=ledger.checkpoint_meta(label_round),
+                process_group=ckpt_group)
         stopped_early = True
         diverged = True
 
@@ -1370,6 +1431,288 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     termination_count = cfg.fed.termination_patience
             sp_stop.end()
 
+        # ---- Elastic live reshard (docs/resilience.md) ----------------
+        def _reshard_join_fn(join_map, tick_round):
+            """join_rows callback for reshard_state: global-model rows for
+            params/anchors, the current round for pull_tick, zeros (fresh
+            optimizer moments / control variates) for everything else —
+            the same joiner semantics as elastic resume."""
+            def jr(path, jidx, row_shape, dtype):
+                if path in join_map:
+                    v = np.asarray(join_map[path])
+                    return np.broadcast_to(
+                        v, (len(jidx),) + tuple(row_shape)).astype(dtype)
+                if path == "['pull_tick']":
+                    return np.full((len(jidx),) + tuple(row_shape),
+                                   tick_round, dtype=dtype)
+                return np.zeros((len(jidx),) + tuple(row_shape), dtype=dtype)
+            return jr
+
+        def _global_join_map():
+            """Join values from the CURRENT global model: state paths under
+            ['params'] and (async) ['anchors'] both join at the live
+            global — a joining client starts from the freshest model, like
+            an elastic-resume joiner."""
+            g = to_numpy(_rep(exp.global_fn(state)))
+            jm = {}
+            for keys, leaf in jax.tree_util.tree_flatten_with_path(g)[0]:
+                sub = jax.tree_util.keystr(keys)
+                jm[f"['params']{sub}"] = np.asarray(leaf)
+                if "anchors" in state:
+                    jm[f"['anchors']{sub}"] = np.asarray(leaf)
+            return jm
+
+        def _victim_grow(rec):
+            """The parked member's rejoin: rebuild its full-topology state
+            from the survivors' spool (replicated values + join rows over
+            its stale structure), re-sync the host-side control state
+            (history, early-stop comparator, DP ledger), and continue at
+            the grow round — its compiled executables and batch still
+            target the original mesh, so nothing recompiles."""
+            nonlocal state, prev_metric, termination_count, rounds_run
+            nonlocal ledger
+            from fedtpu.parallel.reshard import grow_row_map, reshard_state
+            ctl = reshard_ctl
+            seq = ctl.seq        # advanced past the shrink by committed()
+            r_grow = int(rec["round"])
+            src_C = int(rec["src_clients"])
+            orig_C = cfg.shard.num_clients
+            join_map, repl, control = ctl.read_spool(seq)
+            ctl.event("reshard_begin", r_grow, mode="grow_rejoin",
+                      victim=ctl.process_index, target=orig_C)
+            _beat("resharding", r_grow)
+            ctl.publish_ack(seq, "a", r_grow)
+            participants = tuple(sorted(set(ctl.active)
+                                        | {ctl.process_index}))
+            ctl.await_acks(seq, "a", participants)
+            new_state, steps = reshard_state(
+                state, dst_mesh=exp.mesh, dst_clients=orig_C,
+                row_map=grow_row_map(src_C, orig_C,
+                                     int(rec["block_start"])),
+                join_rows=_reshard_join_fn(join_map, r_grow),
+                replicated_values=repl)
+            ctl.publish_ack(seq, "b", r_grow)
+            ctl.await_acks(seq, "b", participants)
+            state = new_state
+            ctl.committed("grow", ctl.process_index)
+            for k in METRIC_NAMES:
+                if control.get("history", {}).get(k) is not None:
+                    history[k] = list(control["history"][k])
+            prev_metric = control.get("prev_metric")
+            termination_count = int(control.get(
+                "termination_count", cfg.fed.termination_patience))
+            if control.get("ledger"):
+                ledger = PrivacyLedger(
+                    cfg.fed, start_round=r_grow,
+                    restored_meta={k: np.asarray(v) for k, v in
+                                   control["ledger"].items()})
+            rounds_run = r_grow
+            ctl.event("reshard_done", r_grow, mode="grow_rejoin",
+                      steps=[s.to_json() for s in steps])
+            _beat("running", r_grow)
+            return r_grow
+
+        def _do_reshard(req, rnd):
+            """Execute one agreed reshard at loop-top ``rnd``: move the
+            live state onto the new mesh with the wire-free planner,
+            rebuild (shrink) or restore (grow) the round executables, and
+            rebind every loop-level reference — then continue at the SAME
+            round, no process restart, no checkpoint restore. Returns the
+            round to continue from (the parked victim returns at the grow
+            round, or exits EXIT_RESHARDED at run end). A participant
+            dying mid-protocol times out the commit barrier and raises
+            ReshardFailed, which crashes this process into the gang
+            supervisor's ordinary restart + checkpoint-resume contract."""
+            nonlocal state, batch, exp, _rep, cfg, eval_step, step_fns
+            nonlocal prev_metric, termination_count, ckpt_group
+            from fedtpu.parallel.mesh import submesh
+            from fedtpu.parallel.reshard import (grow_row_map,
+                                                 host_replicated,
+                                                 is_client_leaf,
+                                                 reshard_state,
+                                                 shrink_row_map)
+            from fedtpu.resilience.reshard import ReshardFailed
+            ctl = reshard_ctl
+            seq = ctl.seq
+            me = ctl.process_index
+            try:
+                if req.mode == "shrink":
+                    src_C = cfg.shard.num_clients
+                    src_devs = list(exp.mesh.devices.flat)
+                    pd = src_C // len(src_devs)
+                    target = req.target_clients
+                    survivors = (me,)
+                    if multiproc:
+                        survivors = tuple(p for p in ctl.active
+                                          if p != req.victim)
+                        n_dst = sum(1 for d in src_devs
+                                    if d.process_index != req.victim)
+                        target = target or pd * n_dst
+                        if target != pd * n_dst:
+                            raise ReshardFailed(
+                                f"shrink target {target} does not match "
+                                f"the surviving devices ({n_dst} devices x "
+                                f"{pd} clients/device)")
+                    elif not target:
+                        log.warning("Ignoring shrink notice: a "
+                                    "single-process signal shrink needs a "
+                                    "fault-plan target_clients.")
+                        return rnd
+                    ctl.event("reshard_begin", rnd, mode="shrink",
+                              victim=req.victim, target=target)
+                    _beat("resharding", rnd)
+                    ctl.maybe_crash()
+                    # Phase A: every PRE-reshard member is at this round's
+                    # loop-top with no collective in flight. A victim that
+                    # died without handing off fails this barrier -> gang
+                    # restart, never a half-resharded continue.
+                    ctl.publish_ack(seq, "a", rnd)
+                    ctl.await_acks(seq, "a", ctl.active)
+                    if multiproc and me == req.victim:
+                        ctl.committed("shrink", req.victim)
+                        log.info(f"Preempted member parking at round {rnd} "
+                                 "(state handed off; will rejoin on grow).")
+                        return _victim_grow(ctl.park(seq, rnd))
+                    dst_mesh = (submesh(exp.mesh, process_indices=survivors,
+                                        num_clients=target)
+                                if multiproc
+                                else submesh(exp.mesh, num_clients=target))
+                    pos = {d.id: i for i, d in enumerate(src_devs)}
+                    rows = []
+                    for d in dst_mesh.devices.flat:
+                        rows.extend(range(pos[d.id] * pd,
+                                          (pos[d.id] + 1) * pd))
+                    if rows != list(range(rows[0], rows[0] + target)):
+                        raise ReshardFailed(
+                            f"surviving client rows {rows} are not one "
+                            "contiguous block; the wire-free plan cannot "
+                            "renumber them")
+                    block_start = rows[0]
+                    with tracer.span("reshard_move", round=rnd):
+                        new_state, steps = reshard_state(
+                            state, dst_mesh=dst_mesh, dst_clients=target,
+                            row_map=shrink_row_map(block_start, target))
+                    # Data repack through the partition view
+                    # (ShardConfig.partition_clients): shard as the
+                    # ORIGINAL full population, keep the survivors'
+                    # window — every kept client's packed batch (padding
+                    # included) is bitwise its pre-shrink one.
+                    P = cfg.shard.partition_clients or src_C
+                    cfg2 = dataclasses.replace(
+                        cfg, shard=dataclasses.replace(
+                            cfg.shard, num_clients=target,
+                            partition_clients=P,
+                            partition_offset=(cfg.shard.partition_offset
+                                              + block_start)))
+                    reshard_stack.append({
+                        "cfg": cfg, "exp": exp, "rep": _rep,
+                        "eval_step": eval_step, "step_fns": step_fns,
+                        "ckpt_group": ckpt_group,
+                        "block_start": block_start})
+                    with tracer.span("reshard_build", round=rnd):
+                        exp2 = build_experiment(cfg2, ds, mesh=dst_mesh)
+                    cfg, exp = cfg2, exp2
+                    state, batch = new_state, exp2.batch
+                    eval_step = exp2.eval_step
+                    step_fns = {}
+                    if multiproc:
+                        from fedtpu.parallel.mesh import replicated_sharding
+                        from fedtpu.utils.trees import identity
+                        _rep = jax.jit(
+                            identity,
+                            out_shardings=replicated_sharding(dst_mesh))
+                    # Phase B: every POST-reshard member holds the rebuilt
+                    # state — only then does anyone dispatch on the shrunk
+                    # mesh.
+                    ctl.publish_ack(seq, "b", rnd)
+                    ctl.await_acks(seq, "b", survivors)
+                    ctl.committed("shrink", req.victim)
+                    if multiproc:
+                        ckpt_group = sorted(ctl.active)
+                    if history[METRIC_NAMES[0]]:
+                        prev_metric = [history[k][-1] for k in METRIC_NAMES]
+                    termination_count = cfg.fed.termination_patience
+                    ctl.event("reshard_done", rnd, mode="shrink",
+                              target=target, block_start=block_start,
+                              steps=[s.to_json() for s in steps])
+                    log.info(f"Elastic shrink at round {rnd}: {src_C} -> "
+                             f"{target} clients (block offset "
+                             f"{block_start}), no restart.")
+                    _beat("running", rnd)
+                    return rnd
+
+                # ---- grow ---------------------------------------------
+                if not reshard_stack:
+                    log.warning("Ignoring grow notice: nothing shrunk.")
+                    return rnd
+                st = reshard_stack[-1]
+                orig_C = st["cfg"].shard.num_clients
+                src_C = cfg.shard.num_clients
+                ctl.event("reshard_begin", rnd, mode="grow",
+                          victim=req.victim, target=orig_C)
+                _beat("resharding", rnd)
+                ctl.maybe_crash()
+                jm = _global_join_map()
+                if multiproc and me == min(ctl.active):
+                    # Leader spools everything the rejoiner needs BEFORE
+                    # publishing the grow record its park loop polls —
+                    # record visibility implies spool completeness.
+                    repl = {}
+                    def _collect(keys, leaf):
+                        if not is_client_leaf(leaf) and hasattr(leaf, "sharding"):
+                            repl[jax.tree_util.keystr(keys)] = \
+                                host_replicated(leaf)
+                        return leaf
+                    jax.tree_util.tree_map_with_path(_collect, state)
+                    ctl.write_spool(ctl.seq, jm, repl, {
+                        "round": rnd,
+                        "history": {k: [float(v) for v in history[k]]
+                                    for k in METRIC_NAMES},
+                        "prev_metric": prev_metric,
+                        "termination_count": termination_count,
+                        "ledger": {k: np.asarray(v).tolist() for k, v in
+                                   ledger.checkpoint_meta(rnd).items()},
+                    })
+                    ctl.publish_grow(ctl.seq, rnd, {
+                        "src_clients": src_C,
+                        "block_start": st["block_start"]})
+                participants = (tuple(sorted(set(ctl.active)
+                                             | {req.victim}))
+                                if multiproc and req.victim >= 0
+                                else ctl.active)
+                ctl.publish_ack(seq, "a", rnd)
+                ctl.await_acks(seq, "a", participants)
+                with tracer.span("reshard_move", round=rnd):
+                    new_state, steps = reshard_state(
+                        state, dst_mesh=st["exp"].mesh,
+                        dst_clients=orig_C,
+                        row_map=grow_row_map(src_C, orig_C,
+                                             st["block_start"]),
+                        join_rows=_reshard_join_fn(jm, rnd))
+                ctl.publish_ack(seq, "b", rnd)
+                ctl.await_acks(seq, "b", participants)
+                reshard_stack.pop()
+                cfg, exp, _rep = st["cfg"], st["exp"], st["rep"]
+                eval_step, step_fns = st["eval_step"], st["step_fns"]
+                ckpt_group = st["ckpt_group"]
+                state, batch = new_state, exp.batch
+                ctl.committed("grow", req.victim)
+                if history[METRIC_NAMES[0]]:
+                    prev_metric = [history[k][-1] for k in METRIC_NAMES]
+                termination_count = cfg.fed.termination_patience
+                ctl.event("reshard_done", rnd, mode="grow", target=orig_C,
+                          steps=[s.to_json() for s in steps])
+                log.info(f"Elastic grow at round {rnd}: {src_C} -> "
+                         f"{orig_C} clients, no restart, no recompile.")
+                _beat("running", rnd)
+                return rnd
+            except ReshardFailed as e:
+                ctl.event("reshard_failed", rnd, error=str(e))
+                _beat("reshard_failed", rnd)
+                log.warning(f"Elastic reshard failed ({e}); degrading to "
+                            "the gang-restart contract.")
+                raise
+
         # Pipelined-stop mode (cfg.run.pipelined_stop): dispatch chunk k+1
         # BEFORE processing chunk k's metrics, so the per-chunk host work
         # (metric fetch + early-stop decision — one dispatch+fetch RTT,
@@ -1411,7 +1754,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                                 _guard("checkpoint", rnd):
                             save_checkpoint(
                                 cfg.run.checkpoint_dir, state, history, rnd,
-                                extra_meta=ledger.checkpoint_meta(rnd))
+                                extra_meta=ledger.checkpoint_meta(rnd),
+                                process_group=ckpt_group)
                             retain_after_save(rnd)
                     tracer.event("preempted", round=rnd)
                     registry.counter("preemptions").inc()
@@ -1420,6 +1764,32 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                     _beat("preempted", rnd)
                     raise Preempted(rnd)
                 break
+            if reshard_ctl is not None and reshard_ctl.pending:
+                if not reshard_live or (multiproc
+                                        and not cfg.run.checkpoint_dir):
+                    # This config cannot live-reshard (validated at
+                    # startup for PLAN entries, so only a SIGNAL notice
+                    # reaches here): degrade it to the plain preemption
+                    # drain — checkpoint + exit 75 + gang restart at the
+                    # new size.
+                    reshard_ctl.clear_signal()
+                    if cfg.run.checkpoint_dir:
+                        tracer.event("reshard_degraded", round=rnd)
+                        registry.counter("reshard_degraded").inc()
+                        log.warning("Preemption notice under a config that "
+                                    "cannot live-reshard (rounds_per_step"
+                                    ">1, pipelined_stop, or no checkpoint_"
+                                    "dir); draining via the preempt path.")
+                        preempt["sig"] = getattr(signal, "SIGUSR1", 10)
+                        continue
+                    log.warning("Ignoring preemption notice: no "
+                                "checkpoint_dir to drain to and no "
+                                "live-reshard support in this config.")
+                else:
+                    req = reshard_ctl.poll(rnd)
+                    if req is not None:
+                        rnd = _do_reshard(req, rnd)
+                        continue
             take = min(chunk, cfg.fed.rounds - rnd)
             if injector is not None:
                 # A fault round must run as its own width-1 dispatch so
@@ -1581,7 +1951,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                         _guard("checkpoint", rnd):
                     save_checkpoint(cfg.run.checkpoint_dir, state, history,
                                     rnd,
-                                    extra_meta=ledger.checkpoint_meta(rnd))
+                                    extra_meta=ledger.checkpoint_meta(rnd),
+                                    process_group=ckpt_group)
                     retain_after_save(rnd)
 
         if pending is not None and not stopped_early:
@@ -1600,6 +1971,13 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # (up to one chunk past rounds_run), and halt_diverged's
             # contract is label == saved state.
             halt_diverged(f"params/optimizer state after round {rnd}", rnd)
+        if reshard_ctl is not None:
+            # Release any still-parked member: the run is over, and it
+            # must exit EXIT_RESHARDED (76, a non-failure departure to the
+            # gang supervisor) rather than wait for a grow that will
+            # never come. Reached only on clean completion — on a crash
+            # the supervisor's gang teardown collects the parked member.
+            reshard_ctl.finish()
 
     finally:
         if watchdog is not None:

@@ -45,6 +45,29 @@ def make_mesh(num_devices: int = 0, num_clients: int = 0) -> Mesh:
     return Mesh(np.asarray(devices[:n]), (CLIENTS_AXIS,))
 
 
+def submesh(mesh: Mesh, process_indices=None, num_devices: int = 0,
+            num_clients: int = 0) -> Mesh:
+    """Reshard-capable mesh rebuild: a 1-D ('clients',) mesh over a SUBSET of
+    ``mesh``'s devices, preserving their original order (so surviving client
+    blocks keep their device-order positions and the post-reshard collective
+    schedule matches a fresh mesh of the same extent).
+
+    ``process_indices``: keep only devices owned by these processes (the
+    surviving gang after a preemption shrink). ``num_devices``: cap the
+    total device count (single-process device shrink). Either way the final
+    extent is trimmed to divide ``num_clients`` when given.
+    """
+    devices = [d for d in mesh.devices.flat
+               if process_indices is None or d.process_index in
+               set(process_indices)]
+    if not devices:
+        raise ValueError("submesh: no devices left for the requested "
+                         f"process set {sorted(process_indices or ())}")
+    n = trim_to_divisor(min(num_devices or len(devices), len(devices)),
+                        num_clients)
+    return Mesh(np.asarray(devices[:n]), (CLIENTS_AXIS,))
+
+
 def client_sharding(mesh: Mesh) -> NamedSharding:
     """NamedSharding that splits an array's leading (clients) axis over the
     mesh — how client shards, per-client params, and per-client optimizer

@@ -38,6 +38,27 @@ processes x two virtual CPU devices, wired by ``jax.distributed`` via
                        maintenance-event case): all drain the collective
                        checkpoint, exit 75, restart without backoff.
 
+The ELASTIC rows exercise the live-reshard path (fedtpu.resilience.
+reshard): a preemption NOTICE arrives for worker 1 and the gang resizes
+itself without any restart — the bar is zero gang restarts, a completed
+reshard in the event log, and a bitwise pre-notice history prefix
+(post-reshard rounds legitimately differ: the client set changed):
+
+  mp_shrink       Plan notice preempts worker 1; the gang shrinks the
+                  client axis onto process 0 mid-run, worker 1 parks and
+                  exits 76 when the run ends. No gang restart.
+  mp_grow         mp_shrink plus a cancel two rounds later: the parked
+                  worker rejoins from the leader's spool and the gang
+                  grows back to full width. Two completed reshards, no
+                  gang restart, no recompile on the grow.
+  mp_shrink_dead  The preempted worker DIES mid-reshard (before its
+                  phase-A ack). The survivor's agreement barrier times
+                  out, the reshard aborts (``reshard_failed``), and the
+                  PR-5 gang-restart contract takes over: restart,
+                  resume, and a FULLY bitwise history — the launch-nonce
+                  generation tags keep the dead reshard's records from
+                  split-braining the resumed gang.
+
 "History" is the ``--metrics-jsonl`` per-round record with timing
 stripped. Restarted/rolled-back runs append re-executed rounds to the
 same sink, so the comparison takes the LAST record per round — exactly
@@ -62,7 +83,7 @@ from typing import List, Optional, Sequence
 
 SCENARIOS = ("sigkill", "preempt", "nan_rollback", "dropout", "straggler",
              "mp_kill_worker", "mp_kill_coordinator", "mp_hang",
-             "mp_preempt")
+             "mp_preempt", "mp_shrink", "mp_grow", "mp_shrink_dead")
 
 # The gang rows: 2 OS processes x 2 virtual CPU devices each, wired into
 # one jax.distributed runtime by `supervise --num-processes 2`. Their
@@ -70,12 +91,20 @@ SCENARIOS = ("sigkill", "preempt", "nan_rollback", "dropout", "straggler",
 # across device counts, so the single-process baseline is not the right
 # bitwise reference).
 MP_SCENARIOS = ("mp_kill_worker", "mp_kill_coordinator", "mp_hang",
-                "mp_preempt")
+                "mp_preempt", "mp_shrink", "mp_grow", "mp_shrink_dead")
+# The elastic subset: a preemption NOTICE instead of a kill — the gang
+# must resize itself live (fedtpu.resilience.reshard), not restart.
+RESHARD_SCENARIOS = ("mp_shrink", "mp_grow", "mp_shrink_dead")
 MP_PROCESSES = 2
 MP_DEVICES_PER_PROC = 2
 # Watchdog budget for the gang rows: far above the tiny CPU job's
 # healthy blocking window (milliseconds), far below the test timeout.
 MP_COLLECTIVE_TIMEOUT = 12.0
+# mp_shrink_dead only: the reshard agreement barrier reuses the
+# collective timeout as its ack budget, and the survivor must hit that
+# timeout (and log ``reshard_failed``) BEFORE the gang supervisor's
+# teardown grace SIGKILLs it — so the dead row runs a shorter watchdog.
+MP_RESHARD_DEAD_TIMEOUT = 6.0
 
 # Metric-history fields compared across runs (sec_per_round is wall
 # clock — the one thing faults are ALLOWED to change).
@@ -88,28 +117,39 @@ def _fault_round(rounds: int) -> int:
     return max(2, rounds // 2 + 1)
 
 
-def _plan(rounds: int, kind: str) -> str:
+def _plan(rounds: int, kind: str, num_clients: int = 4) -> str:
     k = _fault_round(rounds)
-    fault = {
-        "sigkill": {"kind": "process_kill", "round": k,
-                    "signal": "SIGKILL"},
-        "preempt": {"kind": "process_kill", "round": k,
-                    "signal": "SIGTERM"},
-        "nan_rollback": {"kind": "nan_update", "round": k, "clients": [1]},
-        "dropout": {"kind": "client_dropout", "round": k, "clients": [1]},
-        "straggler": {"kind": "straggler", "round": k, "clients": [0],
-                      "delay_s": 0.25},
-        "mp_kill_worker": {"kind": "process_kill", "round": k,
-                           "signal": "SIGKILL", "process_index": 1},
-        "mp_kill_coordinator": {"kind": "process_kill", "round": k,
-                                "signal": "SIGKILL", "process_index": 0},
-        "mp_hang": {"kind": "collective_hang", "round": k,
-                    "process_index": 1},
+    # Elastic notice: worker 1 is preempted; the surviving process keeps
+    # its own device block, so the post-shrink width is half the clients.
+    notice = {"kind": "preempt_notice", "round": k,
+              "target_clients": num_clients // 2, "process_index": 1}
+    faults = {
+        "sigkill": [{"kind": "process_kill", "round": k,
+                     "signal": "SIGKILL"}],
+        "preempt": [{"kind": "process_kill", "round": k,
+                     "signal": "SIGTERM"}],
+        "nan_rollback": [{"kind": "nan_update", "round": k,
+                          "clients": [1]}],
+        "dropout": [{"kind": "client_dropout", "round": k, "clients": [1]}],
+        "straggler": [{"kind": "straggler", "round": k, "clients": [0],
+                       "delay_s": 0.25}],
+        "mp_kill_worker": [{"kind": "process_kill", "round": k,
+                            "signal": "SIGKILL", "process_index": 1}],
+        "mp_kill_coordinator": [{"kind": "process_kill", "round": k,
+                                 "signal": "SIGKILL", "process_index": 0}],
+        "mp_hang": [{"kind": "collective_hang", "round": k,
+                     "process_index": 1}],
         # process_index -1 = every process: the whole-slice preemption.
-        "mp_preempt": {"kind": "process_kill", "round": k,
-                       "signal": "SIGTERM", "process_index": -1},
+        "mp_preempt": [{"kind": "process_kill", "round": k,
+                        "signal": "SIGTERM", "process_index": -1}],
+        "mp_shrink": [notice],
+        "mp_shrink_dead": [notice],
+        # Cancel two rounds after the notice: the parked worker rejoins
+        # and the tail of the run trains at full width again.
+        "mp_grow": [notice, {"kind": "preempt_cancel",
+                             "round": min(k + 2, rounds)}],
     }[kind]
-    return json.dumps({"seed": 0, "faults": [fault]})
+    return json.dumps({"seed": 0, "faults": faults})
 
 
 def _child_env() -> dict:
@@ -168,29 +208,46 @@ def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
     """One scenario run + verdict row (see module docstring for bars)."""
     ck = os.path.join(workdir, f"{name}.ck")
     mp = name in MP_SCENARIOS
+    reshard = name in RESHARD_SCENARIOS
     run_args = _run_args(workdir, name, rounds, num_clients, platform)
-    run_args += ["--fault-plan", _plan(rounds, name),
+    run_args += ["--fault-plan", _plan(rounds, name, num_clients),
                  "--checkpoint-dir", ck, "--checkpoint-every", "2"]
     if name == "nan_rollback":
         run_args += ["--on-divergence", "rollback", "--rollback-retries", "2"]
     if mp:
         # Every gang row carries the watchdog: a hang anywhere must
         # become a restart, never a hung test (mp_hang depends on it;
-        # the kill rows get it as a backstop).
-        run_args += ["--collective-timeout", str(MP_COLLECTIVE_TIMEOUT)]
+        # the kill rows get it as a backstop). It doubles as the reshard
+        # agreement-barrier budget — mp_shrink_dead shortens it so the
+        # survivor logs the barrier timeout before teardown reaps it.
+        ct = (MP_RESHARD_DEAD_TIMEOUT if name == "mp_shrink_dead"
+              else MP_COLLECTIVE_TIMEOUT)
+        run_args += ["--collective-timeout", str(ct)]
         argv = ["supervise", "--num-processes", str(MP_PROCESSES),
                 "--max-restarts", "2", "--grace", "10", "--events",
                 os.path.join(workdir, f"{name}.events.jsonl"),
                 "--", *run_args]
+        if reshard:
+            # The parked victim self-reports through its heartbeat, and
+            # the supervisor's all-parked SIGTERM nudge (the backstop
+            # for a missed run-done marker) only works when it can see
+            # the per-process heartbeat files.
+            argv[1:1] = ["--heartbeat", os.path.join(workdir, f"{name}.hb")]
     elif name in ("sigkill", "preempt"):
         argv = ["supervise", "--max-restarts", "2", "--events",
                 os.path.join(workdir, f"{name}.events.jsonl"),
                 "--", *run_args]
     else:
         argv = run_args
+    env = _mp_env() if mp else _child_env()
+    if name == "mp_shrink_dead":
+        # The victim (process 1) SIGKILLs itself inside the reshard,
+        # after the begin event but before its phase-A ack — the
+        # "preempted host dies during the reshard collective" case.
+        env["FEDTPU_RESHARD_CRASH"] = "1"
     out = subprocess.run([sys.executable, "-m", "fedtpu.cli", *argv],
-                         env=_mp_env() if mp else _child_env(),
-                         capture_output=True, text=True, timeout=timeout)
+                         env=env, capture_output=True, text=True,
+                         timeout=timeout)
 
     hist = _history(os.path.join(workdir, f"{name}.metrics.jsonl"))
     res = _resilience(os.path.join(workdir, f"{name}.events.jsonl"))
@@ -203,7 +260,17 @@ def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
         # would mean the fault silently didn't apply.
         history_ok = (prefix_ok and sorted(hist) == sorted(baseline)
                       and hist.get(k) != baseline.get(k))
+    elif name in ("mp_shrink", "mp_grow"):
+        # Live reshard: every round exists and the pre-notice prefix is
+        # bitwise, but rounds trained on the resized gang aggregate a
+        # different client set — full equality would mean the reshard
+        # silently didn't happen.
+        history_ok = (prefix_ok and sorted(hist) == sorted(baseline)
+                      and hist.get(k) != baseline.get(k))
     else:
+        # mp_shrink_dead lands here on purpose: the aborted reshard must
+        # leave NO trace in the math — gang restart + resume replays the
+        # whole tail bitwise, exactly the mp_kill_worker bar.
         history_ok = full_ok
     row = {
         "scenario": name,
@@ -215,16 +282,27 @@ def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
         "rollbacks": len(res.get("rollbacks") or []),
         "gang_restarts": res.get("gang_restarts") or 0,
         "collective_hangs": len(res.get("collective_hangs") or []),
+        "reshards": len(res.get("reshards") or []),
+        "reshard_failures": len(res.get("reshard_failures") or []),
     }
+    # The notice rows inject no injector-visible fault (the controller
+    # consumes the notice), and the live rows must NOT gang-restart —
+    # that zero is the whole point of elastic resharding.
+    gang_ok = (row["gang_restarts"] == 0 if name in ("mp_shrink", "mp_grow")
+               else row["gang_restarts"] >= 1 if mp else True)
     row["ok"] = (row["survived"] and row["history_match"]
-                 and row["faults"] >= 1
+                 and (row["faults"] >= 1 if not reshard else True)
                  and (row["restarts"] >= 1
                       if name in ("sigkill", "preempt") else True)
-                 and (row["gang_restarts"] >= 1 if mp else True)
+                 and gang_ok
                  and (row["collective_hangs"] >= 1
                       if name == "mp_hang" else True)
                  and (row["rollbacks"] >= 1
-                      if name == "nan_rollback" else True))
+                      if name == "nan_rollback" else True)
+                 and (row["reshards"] >= 1 if name == "mp_shrink" else True)
+                 and (row["reshards"] >= 2 if name == "mp_grow" else True)
+                 and (row["reshards"] == 0 and row["reshard_failures"] >= 1
+                      if name == "mp_shrink_dead" else True))
     if not row["ok"]:
         row["stderr_tail"] = (out.stderr or "")[-2000:]
     return row
@@ -307,6 +385,9 @@ def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
                 gang = (f" gang_restarts={row['gang_restarts']} "
                         f"collective_hangs={row['collective_hangs']}"
                         if name in MP_SCENARIOS else "")
+                if name in RESHARD_SCENARIOS:
+                    gang += (f" reshards={row['reshards']} "
+                             f"reshard_failures={row['reshard_failures']}")
                 print(f"[chaos]   {name}: {status} rc={row['rc']} "
                       f"survived={row['survived']} "
                       f"history_match={row['history_match']} "

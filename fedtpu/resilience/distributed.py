@@ -76,6 +76,13 @@ ENV_LAUNCH_ID = "FEDTPU_LAUNCH_ID"
 # recognizes round_* names.
 AGREEMENT_DIR = ".agreement"
 
+# Subdirectory of the checkpoint dir holding the elastic-reshard protocol
+# files (fedtpu.resilience.reshard): per-process notice/ack records, the
+# grow spool, and the run-done marker. Same launch-nonce generation
+# discipline as the checkpoint agreement; same shared-filesystem
+# transport; same invisibility to resume/retention.
+RESHARD_DIR = ".reshard"
+
 # Sentinel step meaning "this process sees no complete checkpoint".
 NO_CHECKPOINT = -1
 
@@ -289,6 +296,98 @@ def _clear_stale_records(checkpoint_dir: str,
                 os.unlink(path)
             except OSError:
                 pass
+
+
+# --------------------------------------------------- reshard protocol
+
+def reshard_dir(checkpoint_dir: str) -> str:
+    return os.path.join(os.path.abspath(checkpoint_dir), RESHARD_DIR)
+
+
+def _reshard_file(checkpoint_dir: str, name: str, process_index: int) -> str:
+    return os.path.join(reshard_dir(checkpoint_dir),
+                        f"{name}.p{process_index}.json")
+
+
+def publish_reshard_record(checkpoint_dir: str, name: str,
+                           process_index: int, payload: dict,
+                           restart_count: int = 0,
+                           launch_id: Optional[str] = None) -> str:
+    """Atomically publish one elastic-reshard protocol record (a notice
+    candidate, a commit ack, ...) for the current generation. Same
+    write-tmp-then-rename discipline as ``publish_local_step``; the
+    generation tag (``launch_id``, ``restart_count``) keeps a relaunched
+    gang from ever acting on a previous life's records — the reshard
+    analogue of the resume split-brain guard."""
+    path = _reshard_file(checkpoint_dir, name, process_index)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(dict(payload, restarts=int(restart_count),
+                       launch=launch_id, pid=os.getpid(),
+                       time=time.time()), fh)
+    os.replace(tmp, path)
+    return path
+
+
+def read_reshard_record(checkpoint_dir: str, name: str, process_index: int,
+                        restart_count: int = 0,
+                        launch_id: Optional[str] = None) -> Optional[dict]:
+    """A peer's published reshard record for THIS generation, or None
+    (absent / mid-write / stale generation or launch)."""
+    try:
+        with open(_reshard_file(checkpoint_dir, name, process_index)) as fh:
+            rec = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if rec.get("restarts") != restart_count:
+        return None
+    if rec.get("launch") != launch_id:
+        return None
+    return rec
+
+
+def await_reshard_records(checkpoint_dir: str, name: str, processes,
+                          restart_count: int = 0,
+                          launch_id: Optional[str] = None,
+                          timeout: float = 60.0,
+                          poll: float = 0.05) -> dict:
+    """Block until every process in ``processes`` has published ``name``
+    for this generation; returns {process_index: record}. TimeoutError on
+    a missing peer — the reshard commit barrier, where a peer that dies
+    MID-reshard must surface as a loud failure the caller degrades to the
+    gang-restart path, never as a half-resharded gang."""
+    deadline = time.monotonic() + timeout
+    missing = set(processes)
+    records = {}
+    while missing:
+        for i in sorted(missing):
+            rec = read_reshard_record(checkpoint_dir, name, i,
+                                      restart_count, launch_id=launch_id)
+            if rec is not None:
+                records[i] = rec
+                missing.discard(i)
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"reshard record '{name}' missing from process(es) "
+                f"{sorted(missing)} after {timeout:.0f}s under "
+                f"{checkpoint_dir}/{RESHARD_DIR} (generation "
+                f"{restart_count}, launch {launch_id})")
+        time.sleep(poll)
+    return records
+
+
+def clear_reshard_records(checkpoint_dir: str) -> None:
+    """Remove the whole reshard protocol directory (spool included) —
+    process-0 hygiene at run start and after a clean run end, so a later
+    launch in the same workdir can never observe a dead gang's notices."""
+    import shutil
+    try:
+        shutil.rmtree(reshard_dir(checkpoint_dir))
+    except OSError:
+        pass
 
 
 def agree_resume_step(checkpoint_dir: str, process_index: int,

@@ -51,6 +51,12 @@ Fault semantics (see docs/resilience.md for the full taxonomy):
   peers abort with exit 75 and the gang supervisor restarts everyone;
   once-only like ``process_kill``. ``process_index: -1`` on the
   process-targeted kinds means every process (the gang-wide preemption).
+* ``preempt_notice`` / ``preempt_cancel`` — the deterministic elastic-
+  reshard schedule (fedtpu.resilience.reshard): at the named round the
+  loop live-reshards the client axis down to ``target_clients`` with
+  ``process_index`` departing (notice), or back up to the pre-shrink
+  topology (cancel) — no teardown, no checkpoint restore. Consumed by
+  the ReshardController, not applied here; once-only across restarts.
 """
 
 from __future__ import annotations
@@ -68,13 +74,27 @@ import jax.numpy as jnp
 import numpy as np
 
 KINDS = ("client_dropout", "straggler", "nan_update", "process_kill",
-         "ckpt_corrupt", "collective_hang")
+         "ckpt_corrupt", "collective_hang", "preempt_notice",
+         "preempt_cancel")
 
 # Faults that must fire at most once per RUN even across supervisor
 # restarts: a restarted run resumes BELOW the fault round, so re-arming a
 # kill would loop forever (kill -> restart -> replay -> kill ...). Armed
 # only on the first launch (FEDTPU_RESTARTS == 0 / restart_count == 0).
-ONCE_KINDS = ("process_kill", "ckpt_corrupt", "collective_hang")
+# The preempt kinds are once-only too: a gang restart mid-reshard resumes
+# from checkpoint at the PRE-reshard topology, and replaying the notice
+# would re-enter the very reshard that just failed.
+ONCE_KINDS = ("process_kill", "ckpt_corrupt", "collective_hang",
+              "preempt_notice", "preempt_cancel")
+
+# Kinds consumed by the elastic-reshard controller
+# (fedtpu.resilience.reshard), not by the in-loop injector: a
+# ``preempt_notice`` at round k means "process ``process_index`` is
+# preempted — shrink the client axis to ``target_clients`` BEFORE round k
+# trains"; ``preempt_cancel`` grows back to the pre-shrink topology. The
+# injector still honors them in ``chunk_limit`` (the reshard round must
+# start at a loop-top on every process) but never applies them.
+RESHARD_KINDS = ("preempt_notice", "preempt_cancel")
 
 # process_index=-1 on a process-targeted fault means EVERY process (the
 # gang-wide preemption case: a maintenance event SIGTERMs the whole slice
@@ -93,8 +113,9 @@ class Fault:
     clients: Tuple[int, ...] = ()
     delay_s: float = 0.0              # straggler only
     signal: str = "SIGKILL"           # process_kill only
-    process_index: int = 0            # process_kill only
+    process_index: int = 0            # process_kill / preempt_* only
     sticky: bool = False              # client_dropout only
+    target_clients: int = 0           # preempt_* only: post-reshard C
 
     def payload(self) -> dict:
         """Tracer-event payload (only the fields this kind uses). The
@@ -112,6 +133,9 @@ class Fault:
             out["process_index"] = self.process_index
             if self.delay_s:
                 out["delay_s"] = self.delay_s
+        if self.kind in RESHARD_KINDS:
+            out["process_index"] = self.process_index
+            out["target_clients"] = self.target_clients
         if self.sticky:
             out["sticky"] = True
         return out
@@ -182,6 +206,15 @@ class FaultPlan:
             delay = float(entry.get("delay_s", 0.0))
             if kind == "straggler" and delay <= 0:
                 raise ValueError(f"fault #{i}: straggler needs delay_s > 0")
+            target = int(entry.get("target_clients", 0))
+            if kind == "preempt_notice" and not 1 <= target < num_clients:
+                raise ValueError(
+                    f"fault #{i}: preempt_notice needs target_clients in "
+                    f"[1, {num_clients}) — the post-shrink client count")
+            if kind == "preempt_cancel" and not 0 <= target <= num_clients:
+                raise ValueError(
+                    f"fault #{i}: preempt_cancel target_clients {target} "
+                    f"outside [0, {num_clients}] (0 = the original count)")
             for k in hits:
                 if not 1 <= k <= rounds:
                     raise ValueError(f"fault #{i}: round {k} outside "
@@ -190,7 +223,8 @@ class FaultPlan:
                     kind=kind, round=k, clients=clients, delay_s=delay,
                     signal=sig,
                     process_index=int(entry.get("process_index", 0)),
-                    sticky=bool(entry.get("sticky", False))))
+                    sticky=bool(entry.get("sticky", False)),
+                    target_clients=target))
         faults.sort(key=lambda f: f.round)
         canon = json.dumps(
             {"seed": seed,
@@ -302,7 +336,15 @@ class FaultInjector:
                  tracer=None, registry=None, process_index: int = 0):
         self.plan = plan
         self._armed = [f for f in plan.faults
-                       if not (f.kind in ONCE_KINDS and restart_count > 0)]
+                       if f.kind not in RESHARD_KINDS
+                       and not (f.kind in ONCE_KINDS and restart_count > 0)]
+        # Reshard kinds are applied by the ReshardController, but their
+        # rounds still bound the chunk width here: every process's
+        # loop-top must land exactly on the reshard round even when chunk
+        # widths drift across processes.
+        self._reshard_rounds = tuple(
+            f.round for f in plan.faults
+            if f.kind in RESHARD_KINDS and restart_count == 0)
         self._tracer = tracer
         self._registry = registry
         self._proc = process_index
@@ -317,8 +359,9 @@ class FaultInjector:
         keeps every fault round in a width-1 dispatch (a fault at 1-based
         round k applies before dispatching round index k-1, and its
         post-round restore needs that round to end the chunk)."""
-        nxt = min((f.round - 1 for f in self._armed if f.round - 1 >= rnd),
-                  default=None)
+        rounds = [f.round - 1 for f in self._armed if f.round - 1 >= rnd]
+        rounds += [r - 1 for r in self._reshard_rounds if r - 1 >= rnd]
+        nxt = min(rounds, default=None)
         if nxt is None or nxt >= rnd + take:
             return take
         return 1 if nxt == rnd else nxt - rnd

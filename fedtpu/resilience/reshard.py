@@ -1,0 +1,449 @@
+"""Elastic live resharding: resize the gang on preemption notice, no restart.
+
+PRs 4-5 treat any topology change as a death: the gang supervisor tears
+every process down and relaunches with ``--resume`` (checkpoint restore,
+full recompile, lost in-flight round). This module converts a preemption
+NOTICE — the grace window a scheduler gives a host before taking it —
+into a one-round live reshard instead (ROADMAP item 4, in the spirit of
+portable collective redistribution, arXiv 2112.01075):
+
+1. **Notice.** The supervisor forwards SIGUSR1 (shrink) / SIGUSR2 (grow
+   back) to every process (``fedtpu.resilience.supervisor``); or a
+   deterministic ``preempt_notice`` / ``preempt_cancel`` fault plan entry
+   names the round outright (the testable path — every process carries
+   the same plan, so no agreement is needed).
+2. **Agreement.** Signal deliveries race against the round loop, so each
+   process publishes the loop-top round at which it SAW the signal into a
+   launch-nonce-tagged record under ``<checkpoint_dir>/.reshard`` (the
+   same generation discipline as the resume agreement in
+   ``resilience.distributed``). Everyone reshards at round
+   ``max(published) + 1`` — the first loop-top where every peer's record
+   is provably visible (a record published before dispatching round r is
+   readable by every peer's loop-top r+1, because round r's collective
+   orders the filesystem write before the read).
+3. **Redistribution.** The survivors execute a wire-free plan
+   (``fedtpu.parallel.reshard``): per-client slots (params, optimizer
+   moments, control variates, async anchors) re-lay onto the shrunk/
+   grown mesh from each process's own addressable shards; replicated
+   state (round counter, server optimizer, DP clip, K-buffer) rides
+   ``safe_put``. The departing process PARKS — heartbeat status
+   ``parked``, jax runtime alive — so a rescinded preemption grows the
+   gang back without a process relaunch; at run end it exits
+   ``EXIT_RESHARDED`` (76), which the supervisor treats as success.
+4. **Commit.** A two-phase ack barrier (phase A: every pre-reshard
+   member is at the reshard loop-top and out of collectives; phase B:
+   every post-reshard member holds the rebuilt state) bounds every
+   failure: a participant that dies mid-reshard times the barrier out,
+   the survivors raise ``ReshardFailed``, and the crash degrades to the
+   PR-5 gang-restart + checkpoint-resume contract — the launch-nonce
+   tags guarantee the relaunched gang can never act on this life's
+   half-finished protocol records.
+
+Grow-back state for the rejoining process travels through a SPOOL the
+survivor leader writes under ``.reshard/`` — replicated leaves, the join
+row values (current global params / freshest anchor; optimizer moments
+start fresh, matching elastic resume's joiner semantics), and a control
+blob (metric history, early-stop comparator, DP accountant state) — so
+the rejoiner needs nothing from its stale parked copies but their
+structure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal as _signal
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fedtpu.resilience.distributed import (await_reshard_records,
+                                           publish_reshard_record,
+                                           read_reshard_record,
+                                           reshard_dir)
+from fedtpu.resilience.faults import RESHARD_KINDS, FaultPlan
+from fedtpu.resilience.supervisor import EXIT_RESHARDED, write_heartbeat
+
+__all__ = [
+    "EXIT_RESHARDED",
+    "ENV_PREEMPT_VICTIM",
+    "ENV_RESHARD_CRASH",
+    "ReshardFailed",
+    "ReshardRequest",
+    "ReshardController",
+]
+
+# Signal-path victim selection: the process index the preemption notice
+# targets (a real scheduler names the host; the drill env var stands in).
+# Default: the highest-indexed ACTIVE process.
+ENV_PREEMPT_VICTIM = "FEDTPU_PREEMPT_VICTIM"
+
+# Test hook for the failure-during-reshard path: the matching process
+# SIGKILLs itself after the reshard_begin event, BEFORE publishing its
+# phase-A ack — its peers' barrier times out and degrades to gang-restart.
+ENV_RESHARD_CRASH = "FEDTPU_RESHARD_CRASH"
+
+_DONE = "run_done"
+
+
+class ReshardFailed(RuntimeError):
+    """The reshard protocol could not complete (a participant died or
+    never acked). The run loop lets this propagate as a crash so the gang
+    supervisor applies the ordinary restart + resume contract."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardRequest:
+    """One agreed reshard, fired at a loop-top."""
+
+    mode: str            # 'shrink' | 'grow'
+    round: int           # 0-based loop-top round the reshard fires at
+    target_clients: int  # post-reshard client count (0 = loop computes)
+    victim: int          # departing/rejoining process index (-1: none)
+    seq: int             # reshard ordinal within this run
+
+
+class ReshardController:
+    """Owns the reshard protocol state for one process of one run: the
+    deterministic plan schedule, the signal-path agreement, the ack
+    barriers, the grow spool, and the victim's park loop. The round loop
+    calls ``poll`` at every loop-top and drives the state movement itself
+    (it owns the experiment/state bindings); everything cross-process
+    lives here."""
+
+    def __init__(self, *, plan: Optional[FaultPlan] = None,
+                 process_index: int = 0, process_count: int = 1,
+                 launch_id: Optional[str] = None, restart_count: int = 0,
+                 checkpoint_dir: Optional[str] = None,
+                 ack_timeout: float = 60.0, tracer=None, registry=None,
+                 heartbeat: Optional[str] = None):
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.launch_id = launch_id
+        self.restart_count = int(restart_count)
+        self.checkpoint_dir = checkpoint_dir
+        self.ack_timeout = float(ack_timeout) if ack_timeout else 60.0
+        self.tracer = tracer
+        self.registry = registry
+        self.heartbeat = heartbeat
+        # Deterministic schedule: reshard kinds are once-only — a gang
+        # restart mid-reshard resumes at the pre-reshard topology and
+        # must NOT replay the notice that just failed.
+        self._scheduled = ([f for f in plan.faults
+                            if f.kind in RESHARD_KINDS]
+                           if plan is not None and restart_count == 0 else [])
+        self.seq = 0
+        self.active = tuple(range(self.process_count))
+        self.parked_victim: Optional[int] = None
+        self.steps_log: List[dict] = []      # telemetry: executed plan rows
+        # Signal path (guarded: handlers run between bytecodes).
+        self._sig_lock = threading.Lock()
+        self._sig_mode: Optional[str] = None
+        self._notice_round: Optional[int] = None
+
+    # ------------------------------------------------------------ signals
+
+    def install_signal_handlers(self) -> None:
+        """SIGUSR1 -> shrink notice, SIGUSR2 -> grow notice. Main thread
+        only (signal module contract); the supervisor forwards the
+        signals it receives to every child."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for name, mode in (("SIGUSR1", "shrink"), ("SIGUSR2", "grow")):
+            sig = getattr(_signal, name, None)
+            if sig is None:
+                continue
+            _signal.signal(sig, self._make_handler(mode))
+
+    def _make_handler(self, mode: str):
+        def _handler(signum, frame):
+            with self._sig_lock:
+                if self._sig_mode is None:
+                    self._sig_mode = mode
+        return _handler
+
+    def request_signal(self, mode: str) -> None:
+        """Programmatic stand-in for the signal (tests)."""
+        with self._sig_lock:
+            if self._sig_mode is None:
+                self._sig_mode = mode
+
+    # ------------------------------------------------------------ polling
+
+    def _default_victim(self, mode: str) -> int:
+        env = os.environ.get(ENV_PREEMPT_VICTIM, "")
+        if env:
+            return int(env)
+        if mode == "grow":
+            return self.parked_victim if self.parked_victim is not None else -1
+        return max(self.active) if self.active else -1
+
+    def _poll_plan(self, rnd: int) -> Optional[ReshardRequest]:
+        due = [f for f in self._scheduled if f.round - 1 == rnd]
+        if not due:
+            return None
+        self._scheduled = [f for f in self._scheduled if f.round - 1 != rnd]
+        f = due[0]
+        mode = "shrink" if f.kind == "preempt_notice" else "grow"
+        victim = f.process_index if self.process_count > 1 else -1
+        if mode == "grow" and self.parked_victim is not None:
+            victim = self.parked_victim
+        return ReshardRequest(mode=mode, round=rnd,
+                              target_clients=f.target_clients,
+                              victim=victim, seq=self.seq)
+
+    def _poll_signal(self, rnd: int) -> Optional[ReshardRequest]:
+        with self._sig_lock:
+            mode = self._sig_mode
+        if mode is None:
+            return None
+        if mode == "grow" and self.parked_victim is None \
+                and self.process_count > 1:
+            with self._sig_lock:
+                self._sig_mode = None   # nothing to grow back
+            return None
+        if self.process_count == 1:
+            with self._sig_lock:
+                self._sig_mode = None
+            return ReshardRequest(mode=mode, round=rnd, target_clients=0,
+                                  victim=-1, seq=self.seq)
+        if self.checkpoint_dir is None:
+            raise ReshardFailed("signal-path reshard needs --checkpoint-dir "
+                                "for the agreement records")
+        name = f"notice{self.seq}"
+        if self._notice_round is None:
+            self._notice_round = rnd
+            publish_reshard_record(
+                self.checkpoint_dir, name, self.process_index,
+                {"round": rnd, "mode": mode,
+                 "victim": self._default_victim(mode)},
+                self.restart_count, launch_id=self.launch_id)
+        participants = (self.active if mode == "grow"
+                        else tuple(range(self.process_count)))
+        participants = tuple(p for p in participants
+                             if p != self.parked_victim)
+        records = {}
+        for p in participants:
+            rec = read_reshard_record(self.checkpoint_dir, name, p,
+                                      self.restart_count,
+                                      launch_id=self.launch_id)
+            if rec is None:
+                return None             # not all published yet: keep going
+            records[p] = rec
+        agreed = max(int(r["round"]) for r in records.values())
+        if rnd < agreed + 1:
+            return None                 # fire at the first provably-visible
+        lead = records[min(records)]    # loop-top AFTER the last notice
+        with self._sig_lock:
+            self._sig_mode = None
+        self._notice_round = None
+        return ReshardRequest(mode=str(lead["mode"]), round=rnd,
+                              target_clients=0, victim=int(lead["victim"]),
+                              seq=self.seq)
+
+    def poll(self, rnd: int) -> Optional[ReshardRequest]:
+        """At loop-top ``rnd`` (0-based): the reshard to execute now, or
+        None. Plan entries take priority (they are exact-round); signal
+        notices converge through the published-round agreement."""
+        req = self._poll_plan(rnd)
+        if req is not None:
+            return req
+        return self._poll_signal(rnd)
+
+    # ------------------------------------------------------- ack barriers
+
+    def maybe_crash(self) -> None:
+        """Failure-drill hook: die unannounced mid-protocol when this
+        process is the configured crash target."""
+        if os.environ.get(ENV_RESHARD_CRASH, "") == str(self.process_index):
+            os.kill(os.getpid(), _signal.SIGKILL)
+
+    def publish_ack(self, seq: int, phase: str, rnd: int) -> None:
+        if self.process_count == 1 or self.checkpoint_dir is None:
+            return
+        publish_reshard_record(self.checkpoint_dir, f"ack{seq}{phase}",
+                               self.process_index, {"round": rnd},
+                               self.restart_count, launch_id=self.launch_id)
+
+    def await_acks(self, seq: int, phase: str, participants) -> None:
+        """Block until every participant acked this (seq, phase); a
+        missing peer is a ReshardFailed — the caller crashes into the
+        gang-restart path rather than continuing half-resharded."""
+        if self.process_count == 1 or self.checkpoint_dir is None:
+            return
+        try:
+            await_reshard_records(self.checkpoint_dir, f"ack{seq}{phase}",
+                                  participants, self.restart_count,
+                                  launch_id=self.launch_id,
+                                  timeout=self.ack_timeout)
+        except TimeoutError as e:
+            raise ReshardFailed(str(e)) from e
+
+    # ------------------------------------------------------------- events
+
+    def event(self, kind: str, rnd: int, **payload) -> None:
+        if self.tracer is not None:
+            self.tracer.event(kind, round=rnd, seq=self.seq,
+                              process=self.process_index, **payload)
+        if self.registry is not None:
+            self.registry.counter(kind).inc()
+
+    # -------------------------------------------------------------- spool
+
+    def _spool_paths(self, seq: int) -> Tuple[str, str]:
+        d = reshard_dir(self.checkpoint_dir)
+        return (os.path.join(d, f"spool{seq}.npz"),
+                os.path.join(d, f"spool{seq}.json"))
+
+    def write_spool(self, seq: int, join_rows: Dict[str, np.ndarray],
+                    replicated: Dict[str, np.ndarray],
+                    control: dict) -> None:
+        """Survivor-leader export for a grow: join row values per client
+        leaf path, replicated leaf values per path, and the host-side
+        control blob (history, comparator, accountant). Written npz first
+        then json (both atomic): the rejoiner keys its wake on the GROW
+        record, which the leader publishes only after this returns."""
+        npz_path, json_path = self._spool_paths(seq)
+        os.makedirs(os.path.dirname(npz_path), exist_ok=True)
+        payload = {f"J{p}": np.asarray(v) for p, v in join_rows.items()}
+        payload.update({f"R{p}": np.asarray(v)
+                        for p, v in replicated.items()})
+        tmp = f"{npz_path}.tmp.{os.getpid()}.npz"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **payload)
+        os.replace(tmp, npz_path)
+        tmp = f"{json_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(dict(control, launch=self.launch_id,
+                           restarts=self.restart_count), fh)
+        os.replace(tmp, json_path)
+
+    def read_spool(self, seq: int) -> Tuple[Dict[str, np.ndarray],
+                                            Dict[str, np.ndarray], dict]:
+        npz_path, json_path = self._spool_paths(seq)
+        with np.load(npz_path, allow_pickle=False) as z:
+            join = {k[1:]: z[k] for k in z.files if k.startswith("J")}
+            repl = {k[1:]: z[k] for k in z.files if k.startswith("R")}
+        with open(json_path) as fh:
+            control = json.load(fh)
+        if control.get("launch") != self.launch_id or \
+                control.get("restarts") != self.restart_count:
+            raise ReshardFailed(
+                f"grow spool {json_path} belongs to another generation "
+                f"(launch {control.get('launch')!r}, restarts "
+                f"{control.get('restarts')!r})")
+        return join, repl, control
+
+    # --------------------------------------------------------------- park
+
+    def park(self, seq: int, rnd: int) -> dict:
+        """The departed member's wait loop: keep the jax runtime (and the
+        supervisor's liveness view) alive until either the survivors grow
+        the gang back (returns the leader's grow record) or the run ends
+        (run-done marker, or a supervisor SIGTERM nudge) — then exit
+        ``EXIT_RESHARDED``, the supervisor's non-failure departure code."""
+        leader = min(p for p in self.active if p != self.process_index)
+        hb_path = None
+        if self.heartbeat:
+            from fedtpu.resilience.distributed import heartbeat_path_for
+            hb_path = heartbeat_path_for(self.heartbeat, self.process_index)
+        stop = {"sig": None}
+        restore = []
+        if threading.current_thread() is threading.main_thread():
+            def _on_term(signum, frame):
+                stop["sig"] = signum
+            for s in (_signal.SIGTERM, _signal.SIGINT):
+                restore.append((s, _signal.signal(s, _on_term)))
+        done_path = os.path.join(reshard_dir(self.checkpoint_dir), _DONE)
+        last_beat = 0.0
+        try:
+            while True:
+                if stop["sig"] is not None:
+                    raise SystemExit(EXIT_RESHARDED)
+                try:
+                    with open(done_path) as fh:
+                        rec = json.load(fh)
+                    if rec.get("launch") == self.launch_id:
+                        raise SystemExit(EXIT_RESHARDED)
+                except (OSError, ValueError):
+                    pass
+                grow = read_reshard_record(self.checkpoint_dir,
+                                           f"grow{seq + 1}", leader,
+                                           self.restart_count,
+                                           launch_id=self.launch_id)
+                if grow is not None:
+                    return grow
+                now = time.monotonic()
+                if hb_path and now - last_beat >= 2.0:
+                    try:
+                        write_heartbeat(hb_path, status="parked", round=rnd,
+                                        restarts=self.restart_count)
+                    except OSError:
+                        pass
+                    last_beat = now
+                time.sleep(0.25)
+        finally:
+            for s, h in restore:
+                _signal.signal(s, h)
+
+    def publish_grow(self, seq: int, rnd: int, payload: dict) -> None:
+        """Survivor-side grow announcement the parked victim polls for.
+        Publish AFTER ``write_spool`` — the record's visibility implies
+        the spool's completeness."""
+        if self.process_count == 1 or self.checkpoint_dir is None:
+            return
+        publish_reshard_record(self.checkpoint_dir, f"grow{seq}",
+                               self.process_index, dict(payload, round=rnd),
+                               self.restart_count, launch_id=self.launch_id)
+
+    # ---------------------------------------------------------- run end
+
+    def finish(self) -> None:
+        """Run-end marker for any still-parked member (leader only —
+        lowest active index). Harmless when nobody is parked."""
+        if (self.parked_victim is None or self.checkpoint_dir is None
+                or self.process_index != min(self.active)):
+            return
+        path = os.path.join(reshard_dir(self.checkpoint_dir), _DONE)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump({"launch": self.launch_id,
+                       "restarts": self.restart_count,
+                       "time": time.time()}, fh)
+        os.replace(tmp, path)
+
+    # ------------------------------------------------------- bookkeeping
+
+    @property
+    def pending(self) -> bool:
+        """A reshard is scheduled or signaled but not yet executed."""
+        with self._sig_lock:
+            sig = self._sig_mode is not None
+        return sig or bool(self._scheduled)
+
+    @property
+    def signal_pending(self) -> bool:
+        """A SIGNAL notice is pending (plan entries excluded) — the loop
+        degrades these to a SIGTERM-style drain when the current config
+        cannot live-reshard."""
+        with self._sig_lock:
+            return self._sig_mode is not None
+
+    def clear_signal(self) -> None:
+        with self._sig_lock:
+            self._sig_mode = None
+
+    def committed(self, mode: str, victim: int) -> None:
+        """Record a completed reshard: advance the ordinal and the active
+        set (who participates in barriers and checkpoint collectives)."""
+        self.seq += 1
+        if mode == "shrink" and victim >= 0:
+            self.active = tuple(p for p in self.active if p != victim)
+            self.parked_victim = victim
+        elif mode == "grow" and victim >= 0:
+            self.active = tuple(sorted(set(self.active) | {victim}))
+            self.parked_victim = None

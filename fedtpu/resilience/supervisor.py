@@ -10,9 +10,22 @@ The contract between the round loop, the CLI, and the supervisor:
 * ``EXIT_PREEMPTED`` (75, BSD EX_TEMPFAIL) — the loop caught SIGTERM,
   drained to a checkpoint, and exited cleanly; the supervisor restarts
   immediately with ``--resume`` (no backoff — the exit was graceful).
+* ``EXIT_RESHARDED`` (76) — a gang member departed through a COMPLETED
+  elastic reshard (fedtpu.resilience.reshard): its client slots moved to
+  the survivors and it parked until the run ended. Not a failure: no
+  teardown, no restart — the survivors finish the run.
 * anything else — a crash (SIGKILL shows up as a negative returncode);
   the supervisor restarts with ``--resume`` under bounded exponential
-  backoff.
+  backoff. The backoff exponent follows the CRASH STREAK, not the
+  lifetime restart count: a child that stayed healthy past
+  ``healthy_window`` seconds resets the escalation, so an incident
+  tomorrow starts from base backoff instead of inheriting today's.
+
+Preemption notice: SIGUSR1 (shrink) / SIGUSR2 (grow back) sent to the
+supervisor are FORWARDED to every child instead of draining it — the
+in-child ReshardController turns them into a live reshard. The
+supervisor stays agnostic: it only learns the outcome through exit
+codes (76 = departed cleanly) and heartbeat status (``parked``).
 
 Liveness: the loop writes a heartbeat file (``--heartbeat``, atomic
 tmp+rename) at start and every chunk; ``--hang-timeout`` turns a stale
@@ -44,6 +57,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 EXIT_OK = 0
 EXIT_DIVERGED = 3
 EXIT_PREEMPTED = 75          # EX_TEMPFAIL: drained to checkpoint, resumable
+# The gang member departed through a completed elastic reshard
+# (fedtpu.resilience.reshard): it handed its client slots to the
+# survivors and parked until the run ended. NOT a failure — the gang
+# supervisor must neither tear the survivors down nor restart anyone.
+EXIT_RESHARDED = 76
 
 
 class Preempted(Exception):
@@ -98,6 +116,13 @@ def _wait(child: subprocess.Popen, signaled: dict, heartbeat: Optional[str],
             return child.wait(timeout=0.2), False
         except subprocess.TimeoutExpired:
             pass
+        usr = signaled.pop("usr", None)
+        if usr is not None:
+            # Preemption notice, not a stop: forward and keep supervising.
+            try:
+                child.send_signal(usr)
+            except OSError:
+                pass
         if signaled["sig"] is not None:
             return _drain_child(child, grace), False
         if hang_timeout and heartbeat:
@@ -110,11 +135,65 @@ def _wait(child: subprocess.Popen, signaled: dict, heartbeat: Optional[str],
                 return child.wait(), True
 
 
+def _register_handlers(signaled: dict) -> List[Tuple[int, object]]:
+    """SIGTERM/SIGINT -> external stop (drain); SIGUSR1/SIGUSR2 ->
+    preemption notice to forward. Main thread only (signal module
+    contract); returns (signum, previous_handler) pairs to restore."""
+    restore: List[Tuple[int, object]] = []
+    if threading.current_thread() is not threading.main_thread():
+        return restore
+
+    def _on_sig(signum, frame):
+        signaled["sig"] = signum
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        restore.append((s, signal.signal(s, _on_sig)))
+
+    def _on_usr(signum, frame):
+        signaled["usr"] = signum
+
+    for name in ("SIGUSR1", "SIGUSR2"):
+        s = getattr(signal, name, None)
+        if s is not None:
+            restore.append((s, signal.signal(s, _on_usr)))
+    return restore
+
+
+def _cleanup_run_artifacts(child_argv: Sequence[str],
+                           heartbeat: Optional[str],
+                           num_processes: int = 1) -> None:
+    """Clean-run hygiene: a run that ended ``EXIT_OK`` must leave no
+    liveness or agreement residue behind — a later launch in the same
+    workdir polling a DEAD gang's heartbeat mtimes or reading its
+    ``.agreement``/``.reshard`` protocol records could mistake the
+    previous life for a live or resumable one. Heartbeat files are
+    derived per process from the base path; protocol dirs live under the
+    child's ``--checkpoint-dir`` when it has one."""
+    import shutil
+    from fedtpu.resilience.distributed import heartbeat_path_for
+    if heartbeat:
+        for i in range(max(1, num_processes)):
+            try:
+                os.unlink(heartbeat_path_for(heartbeat, i))
+            except OSError:
+                pass
+    argv = list(child_argv)
+    try:
+        idx = argv.index("--checkpoint-dir")
+    except ValueError:
+        return
+    if idx + 1 < len(argv):
+        ckpt = os.path.abspath(argv[idx + 1])
+        for sub in (".agreement", ".reshard"):
+            shutil.rmtree(os.path.join(ckpt, sub), ignore_errors=True)
+
+
 def supervise(child_argv: Sequence[str], max_restarts: int = 2,
               backoff_base: float = 1.0, backoff_max: float = 30.0,
               grace: float = 15.0, hang_timeout: Optional[float] = None,
               heartbeat: Optional[str] = None, events: Optional[str] = None,
               extra_env: Optional[dict] = None,
+              healthy_window: float = 300.0,
               _cmd_prefix: Optional[List[str]] = None,
               verbose: bool = True) -> int:
     """Run ``fedtpu <child_argv>`` as a child process and keep it alive
@@ -142,18 +221,15 @@ def supervise(child_argv: Sequence[str], max_restarts: int = 2,
 
     # Forwarded stop: SIGTERM/SIGINT to the supervisor drains the child
     # and returns ITS code — an external preemption of the whole tree
-    # must not be answered with a restart. Signal handlers only exist on
-    # the main thread; elsewhere (tests driving supervise from a worker)
+    # must not be answered with a restart. SIGUSR1/SIGUSR2 are forwarded
+    # as preemption notices instead. Signal handlers only exist on the
+    # main thread; elsewhere (tests driving supervise from a worker)
     # external stop simply isn't intercepted.
     signaled = {"sig": None}
-    restore: List[Tuple[int, object]] = []
-    if threading.current_thread() is threading.main_thread():
-        def _on_sig(signum, frame):
-            signaled["sig"] = signum
-        for s in (signal.SIGTERM, signal.SIGINT):
-            restore.append((s, signal.signal(s, _on_sig)))
+    restore = _register_handlers(signaled)
 
     restarts = 0
+    crash_streak = 0
     tracer.event("supervisor_start", max_restarts=max_restarts,
                  cmd=prefix + base)
     try:
@@ -182,6 +258,8 @@ def supervise(child_argv: Sequence[str], max_restarts: int = 2,
                 tracer.event("supervisor_exit", rc=rc,
                              reason="done" if rc == EXIT_OK else "diverged",
                              restarts=restarts)
+                if rc == EXIT_OK:
+                    _cleanup_run_artifacts(base, heartbeat)
                 return rc
             if restarts >= max_restarts:
                 tracer.event("supervisor_exit", rc=rc,
@@ -190,14 +268,22 @@ def supervise(child_argv: Sequence[str], max_restarts: int = 2,
                     print(f"[supervise] rc={rc} with restart budget "
                           f"exhausted ({max_restarts}); giving up")
                 return rc
+            # A child that survived past healthy_window earned its way
+            # back to base backoff: the next crash is a NEW incident, not
+            # an escalation of the previous one.
+            if healthy_window and time.time() - started >= healthy_window:
+                crash_streak = 0
             # A heartbeat-detected hang is the same failure mode the
             # watchdog's exit 75 reports (the last periodic checkpoint
             # is intact) — both restart without backoff.
             delay = (0.0 if rc == EXIT_PREEMPTED or hung
-                     else min(backoff_max, backoff_base * (2 ** restarts)))
+                     else min(backoff_max, backoff_base * (2 ** crash_streak)))
+            if delay:
+                crash_streak += 1
             restarts += 1
             tracer.event("restart", restarts=restarts, rc=rc, hung=hung,
-                         backoff_s=delay, resume=is_run)
+                         backoff_s=delay, resume=is_run,
+                         crash_streak=crash_streak)
             if verbose:
                 why = "hung" if hung else (
                     "preempted" if rc == EXIT_PREEMPTED else f"rc={rc}")
@@ -249,8 +335,11 @@ def _wait_gang(children: List[subprocess.Popen], signaled: dict,
     """Poll the gang until it finishes or one member fails. Returns
     ``(trigger_rc, trigger_proc, hung, rcs)`` — ``trigger_proc`` is None
     on clean completion / external stop. A member exiting ``EXIT_OK``
-    early is NOT a failure (peers finish their own epilogue); any other
-    exit, or a stale per-process heartbeat, triggers gang teardown."""
+    early is NOT a failure (peers finish their own epilogue), and neither
+    is ``EXIT_RESHARDED`` (the member departed through a completed
+    elastic reshard — its survivors keep running); any other exit, or a
+    stale per-process heartbeat, triggers gang teardown. SIGUSR1/SIGUSR2
+    preemption notices are forwarded to every live member."""
     from fedtpu.resilience.distributed import heartbeat_path_for
     live: Dict[int, subprocess.Popen] = dict(enumerate(children))
     rcs: Dict[int, int] = {}
@@ -258,15 +347,35 @@ def _wait_gang(children: List[subprocess.Popen], signaled: dict,
         if signaled["sig"] is not None:
             _teardown_gang(live, grace, rcs)
             return max(rcs.values()), None, False, rcs
+        usr = signaled.pop("usr", None)
+        if usr is not None:
+            for c in live.values():
+                try:
+                    c.send_signal(usr)
+                except OSError:
+                    pass
         for i in list(live):
             rc = live[i].poll()
             if rc is None:
                 continue
             rcs[i] = rc
             del live[i]
-            if rc != EXIT_OK:
+            if rc not in (EXIT_OK, EXIT_RESHARDED):
                 _teardown_gang(live, grace, rcs)
                 return rc, i, False, rcs
+        # Belt-and-suspenders for a parked reshard victim that missed the
+        # run-done marker: once every still-live member self-reports
+        # ``parked`` and everyone else ended cleanly, nudge the parked
+        # members with SIGTERM — their park loop answers with a clean
+        # EXIT_RESHARDED.
+        if live and rcs and heartbeat and all(
+                r in (EXIT_OK, EXIT_RESHARDED) for r in rcs.values()):
+            parked = [i for i in live
+                      if (read_heartbeat(heartbeat_path_for(heartbeat, i))
+                          or {}).get("status") == "parked"]
+            if len(parked) == len(live):
+                for i in parked:
+                    live[i].terminate()
         if hang_timeout and heartbeat:
             for i in list(live):
                 hb = heartbeat_path_for(heartbeat, i)
@@ -290,6 +399,7 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
                    heartbeat: Optional[str] = None,
                    events: Optional[str] = None,
                    extra_env: Optional[dict] = None,
+                   healthy_window: float = 300.0,
                    _cmd_prefix: Optional[List[str]] = None,
                    verbose: bool = True) -> int:
     """``supervise()`` for an SPMD gang of ``num_processes`` workers.
@@ -318,8 +428,8 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
                          backoff_base=backoff_base, backoff_max=backoff_max,
                          grace=grace, hang_timeout=hang_timeout,
                          heartbeat=heartbeat, events=events,
-                         extra_env=extra_env, _cmd_prefix=_cmd_prefix,
-                         verbose=verbose)
+                         extra_env=extra_env, healthy_window=healthy_window,
+                         _cmd_prefix=_cmd_prefix, verbose=verbose)
     tracer = make_tracer(events)
     prefix = (list(_cmd_prefix) if _cmd_prefix is not None
               else [sys.executable, "-m", "fedtpu.cli"])
@@ -334,14 +444,10 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
         base += ["--heartbeat", heartbeat]
 
     signaled = {"sig": None}
-    restore: List[Tuple[int, object]] = []
-    if threading.current_thread() is threading.main_thread():
-        def _on_sig(signum, frame):
-            signaled["sig"] = signum
-        for s in (signal.SIGTERM, signal.SIGINT):
-            restore.append((s, signal.signal(s, _on_sig)))
+    restore = _register_handlers(signaled)
 
     restarts = 0
+    crash_streak = 0
     tracer.event("gang_start", num_processes=num_processes,
                  max_restarts=max_restarts, cmd=prefix + base)
     try:
@@ -385,6 +491,9 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
                 tracer.event("supervisor_exit", rc=rc,
                              reason="done" if rc == EXIT_OK else "diverged",
                              restarts=restarts)
+                if rc == EXIT_OK:
+                    _cleanup_run_artifacts(base, heartbeat,
+                                           num_processes=num_processes)
                 return rc
             if restarts >= max_restarts:
                 tracer.event("supervisor_exit", rc=rc,
@@ -394,17 +503,23 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
                           f"restart budget exhausted ({max_restarts}); "
                           "giving up")
                 return rc
+            # A gang that stayed healthy past healthy_window resets the
+            # backoff escalation (see supervise).
+            if healthy_window and time.time() - started >= healthy_window:
+                crash_streak = 0
             # hung == heartbeat-detected hang: _wait_gang SIGKILLed the
             # member, so rc is -9, but the failure mode is the one the
             # collective watchdog reports as exit 75 — the last periodic
             # checkpoint is intact, so restart without backoff exactly
             # like a preemption.
             delay = (0.0 if rc == EXIT_PREEMPTED or hung
-                     else min(backoff_max, backoff_base * (2 ** restarts)))
+                     else min(backoff_max, backoff_base * (2 ** crash_streak)))
+            if delay:
+                crash_streak += 1
             restarts += 1
             tracer.event("gang_restart", restarts=restarts, rc=rc,
                          proc=proc, hung=hung, backoff_s=delay,
-                         resume=is_run,
+                         resume=is_run, crash_streak=crash_streak,
                          coordinator_died=(proc == 0))
             if verbose:
                 why = "hung" if hung else (

@@ -71,6 +71,9 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     gang_restarts: List[dict] = []
     collective_hangs: List[dict] = []
     child_exits: List[dict] = []
+    reshards: List[dict] = []
+    reshard_failures: List[dict] = []
+    reshard_degraded: List[dict] = []
     preempted_rounds: List[int] = []
     resume_rounds: List[int] = []
     diverged_at: Optional[dict] = None
@@ -127,6 +130,26 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             collective_hangs.append({"round": e.get("round"), **payload})
         elif kind == "child_exit":
             child_exits.append(payload)
+        # Elastic reshard timeline (fedtpu.resilience.reshard): a
+        # completed reshard is a topology change WITHOUT a restart, so it
+        # gets its own rows instead of riding gang_restart. The done
+        # event's per-leaf plan steps collapse to totals here — the
+        # report answers "what moved, how much, when", not "which leaf".
+        elif kind == "reshard_done":
+            steps = payload.get("steps") or []
+            reshards.append({
+                "round": e.get("round"),
+                "mode": payload.get("mode"),
+                "target_clients": payload.get("target"),
+                "moved_leaves": len(steps),
+                "moved_bytes": sum(int(s.get("nbytes") or 0)
+                                   for s in steps),
+                "join_rows": sum(int(s.get("join_rows") or 0)
+                                 for s in steps)})
+        elif kind == "reshard_failed":
+            reshard_failures.append({"round": e.get("round"), **payload})
+        elif kind == "reshard_degraded":
+            reshard_degraded.append({"round": e.get("round"), **payload})
         elif kind == "preempted":
             preempted_rounds.append(int(e.get("round") or 0))
         elif kind == "resume":
@@ -202,7 +225,8 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             out["static_analysis"] = manifest["audit"]
     if (faults or rollbacks or exclusions or restarts or gang_restarts
             or collective_hangs or child_exits or preempted_rounds
-            or resume_rounds or diverged_at or supervisor_exit):
+            or resume_rounds or diverged_at or supervisor_exit
+            or reshards or reshard_failures or reshard_degraded):
         out["resilience"] = {
             "faults": faults,
             "rollbacks": rollbacks,
@@ -211,6 +235,9 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             "gang_restarts": len(gang_restarts),
             "collective_hangs": collective_hangs,
             "child_exit_codes": [c.get("rc") for c in child_exits],
+            "reshards": reshards,
+            "reshard_failures": reshard_failures,
+            "reshard_degraded": reshard_degraded,
             "preempted_rounds": preempted_rounds,
             "resume_rounds": resume_rounds,
             "diverged": diverged_at,
@@ -313,6 +340,20 @@ def render_text(agg: dict) -> str:
                          f"process {ch.get('process')} stuck in "
                          f"{ch.get('phase')} for {ch.get('waited_s')} s "
                          f"(timeout {ch.get('timeout_s')} s) -> exit 75")
+        for rs in res.get("reshards") or []:
+            mb = (rs.get("moved_bytes") or 0) / 2**20
+            lines.append(f"  reshard {rs.get('mode')} @ round "
+                         f"{rs.get('round')} -> "
+                         f"{rs.get('target_clients')} client(s): "
+                         f"{rs.get('moved_leaves')} leaves, "
+                         f"~{mb:.2f} MiB placed, "
+                         f"{rs.get('join_rows')} join row(s), no restart")
+        for rf in res.get("reshard_failures") or []:
+            lines.append(f"  RESHARD FAILED @ round {rf.get('round')}: "
+                         f"{rf.get('error')} -> gang-restart fallback")
+        for rd in res.get("reshard_degraded") or []:
+            lines.append(f"  reshard degraded to checkpoint drain @ round "
+                         f"{rd.get('round')} (config cannot live-reshard)")
         if res.get("restarts"):
             lines.append(f"  supervisor restarts: {res['restarts']} "
                          f"(child exit codes: "

@@ -198,6 +198,13 @@ QUICK_TESTS = {
     # test_chaos_supervised runs supervised subprocess CLI children
     # (kill + restart, ~90 s) and stays full-tier only; the in-process
     # resilience semantics are covered by test_resilience above.
+    # round-9 modules
+    # elastic reshard (planner + controller are backend-free numpy/
+    # filesystem, milliseconds; the integrated shrink/grow loop tests
+    # stay full-tier)
+    "test_reshard.py::test_row_maps",
+    "test_reshard.py::test_spool_roundtrip_and_generation_fence",
+    "test_reshard.py::test_signal_agreement_converges",
 }
 
 

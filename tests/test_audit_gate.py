@@ -28,7 +28,10 @@ from fedtpu.cli import main as cli_main
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GOLDENS = os.path.join(REPO, "tests", "goldens")
-PRESETS = ("income-2", "income-8")
+# income-4 is income-8's shrink target: goldening it alongside its parent
+# pins the post-reshard schedule too (see tests/test_reshard.py's
+# shrink-rebuilt-step digest check against this same golden).
+PRESETS = ("income-2", "income-4", "income-8")
 
 
 def _golden_path(preset):

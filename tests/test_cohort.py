@@ -444,3 +444,56 @@ def test_million_client_round_completes_flat(tmp_path):
     assert row["store_apparent_bytes"] > 10**9          # ~1.7 GB apparent
     assert row["store_resident_bytes"] < 64 * 2**20     # cohort-sized
     assert row["peak_rss_bytes"] < 1536 * 2**20         # ~510 MB observed
+
+
+# ------------------------------------------ config-validator rejections
+
+# Every composition the cohort scan body does not reproduce must be
+# rejected at startup by _validate_cohort_config with a message that
+# names the offending knob — a silent wrong-math run is the failure
+# mode these guard against. One row per rejection branch.
+_REJECTIONS = [
+    # (fed overrides, run overrides, message fragment naming the knob)
+    (dict(cohort_size=16), {}, r"cohort_size=16 exceeds the population"),
+    (dict(client_store="redis"), {}, r"client_store must be"),
+    (dict(async_mode=True), {}, r"synchronous engine only"),
+    ({}, dict(model_parallel=2), r"model_parallel=1"),
+    (dict(participation_rate=0.5), {}, r"--participation-rate"),
+    (dict(server_opt="adam"), {}, r"no server_opt / DP"),
+    (dict(dp_clip_norm=1.0), {}, r"no server_opt / DP"),
+    (dict(dp_clip_norm=1.0, dp_noise_multiplier=0.5), {},
+     r"no server_opt / DP"),
+    (dict(dp_clip_norm=1.0, dp_adaptive_clip=True), {},
+     r"no server_opt / DP"),
+    (dict(robust_aggregation="trimmed_mean"), {}, r"robust\s+aggregation"),
+    (dict(byzantine_clients=2), {}, r"robust\s+aggregation"),
+    (dict(compress="8bit"), {}, r"compressed\s+exchange"),
+    (dict(scaffold=True), {}, r"SCAFFOLD"),
+    (dict(personalize_steps=3), {}, r"personalize_steps"),
+    (dict(init_weights_npz="w.npz"), {}, r"init_weights_npz"),
+    ({}, dict(on_divergence="rollback"), r"on_divergence='halt' only"),
+    ({}, dict(fault_plan='{"faults": []}'), r"on_divergence='halt' only"),
+    ({}, dict(pipelined_stop=True), r"pipelined_stop"),
+    (dict(cohort_sampling="trace"), {}, r"--cohort-trace"),
+]
+
+
+@pytest.mark.parametrize("fed_kw,run_kw,match", _REJECTIONS,
+                         ids=[f"{i}:{m[:24]}" for i, (_, _, m)
+                              in enumerate(_REJECTIONS)])
+def test_cohort_config_rejections(fed_kw, run_kw, match):
+    from fedtpu.cohort.scheduler import _validate_cohort_config
+    cfg = _cfg(num_clients=8, cohort_size=4)
+    cfg = dataclasses.replace(
+        cfg,
+        fed=dataclasses.replace(cfg.fed, **fed_kw),
+        run=dataclasses.replace(cfg.run, **run_kw))
+    with pytest.raises(ValueError, match=match):
+        _validate_cohort_config(cfg)
+
+
+def test_cohort_config_valid_baseline_passes():
+    """The base config every rejection row perturbs must itself pass —
+    otherwise the rows above could be failing for the wrong reason."""
+    from fedtpu.cohort.scheduler import _validate_cohort_config
+    _validate_cohort_config(_cfg(num_clients=8, cohort_size=4))

@@ -108,3 +108,78 @@ def test_gang_wide_preemption_drains_and_resumes(gang_env):
         os.path.join(gang_env[0], "mp_preempt.events.jsonl"))
     g = [e for e in events if e["kind"] == "gang_restart"]
     assert g and g[0]["payload"]["backoff_s"] == 0
+
+
+@pytest.mark.slow
+def test_gang_elastic_shrink_resizes_without_restart(gang_env):
+    """The elastic counterpart of mp_preempt: a preemption NOTICE at the
+    fault round live-shrinks the gang (victim parks, survivor continues
+    the SAME run on the halved mesh) — zero gang restarts, and the
+    reshard shows up in the report aggregation with its moved-bytes
+    manifest."""
+    wd, baseline = gang_env
+    row = run_scenario("mp_shrink", wd, baseline, ROUNDS, NUM_CLIENTS,
+                       platform="cpu", timeout=600)
+    assert row["ok"], row
+    assert row["rc"] == 0 and row["gang_restarts"] == 0
+    assert row["reshards"] == 1 and row["reshard_failures"] == 0
+    events, bad = load_events(os.path.join(wd, "mp_shrink.events.jsonl"))
+    res = aggregate(events, malformed=bad)["resilience"]
+    r = res["reshards"][0]
+    assert r["mode"] == "shrink"
+    assert r["target_clients"] == NUM_CLIENTS // 2
+    assert r["moved_leaves"] > 0 and r["moved_bytes"] > 0
+
+
+# ------------------------------------------------- supervisor satellites
+
+
+def test_supervise_cleans_liveness_and_protocol_residue_on_exit_0(tmp_path):
+    """A run that ends EXIT_OK must leave no heartbeat files or
+    .agreement/.reshard protocol records behind: a later launch in the
+    same workdir would mistake the dead gang's residue for a live or
+    resumable one. Round checkpoints survive the sweep."""
+    from fedtpu.resilience.distributed import heartbeat_path_for
+    from fedtpu.resilience.supervisor import supervise
+
+    ck = tmp_path / "ck"
+    for sub in (".agreement", ".reshard", "round_000002"):
+        (ck / sub).mkdir(parents=True)
+    hb = str(tmp_path / "hb")
+    with open(heartbeat_path_for(hb, 0), "w") as fh:
+        fh.write("{}")
+    rc = supervise(["run", "--checkpoint-dir", str(ck)],
+                   max_restarts=0, heartbeat=hb, verbose=False,
+                   _cmd_prefix=["/bin/sh", "-c", "exit 0", "sh"])
+    assert rc == 0
+    assert not os.path.exists(heartbeat_path_for(hb, 0))
+    assert not (ck / ".agreement").exists()
+    assert not (ck / ".reshard").exists()
+    assert (ck / "round_000002").exists()       # checkpoints are kept
+
+
+def test_supervise_backoff_resets_after_healthy_window(tmp_path):
+    """A child that survived past healthy_window starts a NEW incident:
+    its crash backs off at base, not at the escalated streak. With the
+    window disabled the same crashes escalate exponentially."""
+    import json
+
+    from fedtpu.resilience.supervisor import supervise
+
+    def delays(healthy_window):
+        ev = str(tmp_path / f"ev{healthy_window}.jsonl")
+        rc = supervise(["crash"], max_restarts=3, backoff_base=0.05,
+                       backoff_max=10.0, healthy_window=healthy_window,
+                       events=ev, verbose=False,
+                       _cmd_prefix=["/bin/sh", "-c", "sleep 0.3; exit 7",
+                                    "sh"])
+        assert rc == 7
+        with open(ev) as fh:
+            events = [json.loads(ln) for ln in fh if ln.strip()]
+        return [e["payload"]["backoff_s"] for e in events
+                if e["kind"] == "restart"]
+
+    # 0.3 s child lifetime > 0.2 s window: every crash is a fresh incident.
+    assert delays(0.2) == [0.05, 0.05, 0.05]
+    # Window disabled: the streak escalates 2^k.
+    assert delays(0) == [0.05, 0.1, 0.2]

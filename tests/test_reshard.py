@@ -1,0 +1,300 @@
+"""Elastic live resharding (docs/resilience.md "Elastic resharding").
+
+Three layers, mirroring the subsystem split:
+
+- the wire-free redistribution planner (fedtpu.parallel.reshard): row
+  maps, local-row assembly, the no-wire invariant, and bitwise carry;
+- the reshard protocol controller (fedtpu.resilience.reshard): plan/
+  signal polling, ack barriers and their ReshardFailed degradation, the
+  grow spool's generation discipline, and the run-done release;
+- the integrated single-process plan path through run_experiment
+  (shrink then grow in one run, no restart), plus the audit-gate tie-in:
+  a shrink-REBUILT round step must compile to exactly the collective
+  schedule pinned in the income-4 golden (tests/test_audit_gate.py).
+
+The 2-process gang path (agreement records, park/grow-back, the
+mid-reshard death fallback) is exercised end-to-end by
+``fedtpu chaos --scenarios mp_shrink,mp_grow,mp_shrink_dead``.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                           RunConfig, ShardConfig, TelemetryConfig)
+from fedtpu.orchestration.loop import build_experiment, run_experiment
+from fedtpu.parallel.mesh import (client_sharding, make_mesh,
+                                  replicated_sharding, submesh)
+from fedtpu.parallel.reshard import (grow_row_map, host_rows, is_client_leaf,
+                                     reshard_state, shrink_row_map)
+from fedtpu.resilience.faults import FaultPlan
+from fedtpu.resilience.reshard import ReshardController, ReshardFailed
+
+# ---------------------------------------------------------------- planner
+
+
+def test_row_maps():
+    assert shrink_row_map(2, 4) == [2, 3, 4, 5]
+    assert shrink_row_map(0, 3) == [0, 1, 2]
+    # Survivors' rows return to their pre-shrink global positions; the
+    # rest are join rows (-1).
+    assert grow_row_map(4, 8) == [0, 1, 2, 3, -1, -1, -1, -1]
+    assert grow_row_map(4, 8, block_start=2) == [-1, -1, 0, 1, 2, 3, -1, -1]
+
+
+def _mesh_state(num_clients=8):
+    mesh = make_mesh(None, num_clients)
+    c = jax.device_put(
+        np.arange(num_clients * 3, dtype=np.float32).reshape(num_clients, 3),
+        client_sharding(mesh))
+    r = jax.device_put(np.float32(7.0), replicated_sharding(mesh))
+    return mesh, {"params": {"w": c}, "round": r}
+
+
+def test_is_client_leaf():
+    _, state = _mesh_state()
+    assert is_client_leaf(state["params"]["w"])
+    assert not is_client_leaf(state["round"])
+    assert not is_client_leaf(np.zeros(3))      # host leaf: no sharding
+
+
+def test_host_rows_roundtrip():
+    _, state = _mesh_state()
+    w = state["params"]["w"]
+    got = host_rows(w, slice(2, 6))
+    np.testing.assert_array_equal(got, np.asarray(w)[2:6])
+    np.testing.assert_array_equal(host_rows(w, slice(0, 8)), np.asarray(w))
+
+
+def test_host_rows_raises_on_non_addressable_rows():
+    """The no-wire invariant: a row held only by another process is a hard
+    planning error. Single-process arrays are always fully addressable, so
+    the missing-shard topology is stubbed."""
+
+    class _Shard:
+        index = (slice(0, 2),)
+        data = np.zeros((2, 3), dtype=np.float32)
+
+    class _Leaf:
+        shape = (4, 3)
+        dtype = np.float32
+        addressable_shards = [_Shard()]
+
+    with pytest.raises(ValueError, match="not addressable"):
+        host_rows(_Leaf(), slice(0, 4))
+
+
+def test_reshard_state_shrink_is_bitwise():
+    mesh, state = _mesh_state(8)
+    dst = submesh(mesh, num_clients=4)
+    new, steps = reshard_state(state, dst_mesh=dst, dst_clients=4,
+                               row_map=shrink_row_map(2, 4))
+    np.testing.assert_array_equal(np.asarray(new["params"]["w"]),
+                                  np.asarray(state["params"]["w"])[2:6])
+    assert float(new["round"]) == 7.0
+    kinds = {s.path: s.kind for s in steps}
+    assert set(kinds.values()) == {"client", "replicated"}
+    client = [s for s in steps if s.kind == "client"]
+    assert client[0].rows == 4 and client[0].join_rows == 0
+    assert client[0].nbytes == 4 * 3 * 4
+
+
+def test_reshard_state_grow_fills_join_rows():
+    mesh, state = _mesh_state(4)
+    dst = submesh(mesh, num_clients=4)  # same extent; the MAP drives rows
+    fills = {}
+
+    def join(path, jidx, row_shape, dtype):
+        fills[path] = list(jidx)
+        return np.full((len(jidx),) + row_shape, 42.0, dtype=dtype)
+
+    new, steps = reshard_state(
+        state, dst_mesh=make_mesh(None, 8), dst_clients=8,
+        row_map=grow_row_map(4, 8, block_start=2), join_rows=join,
+        replicated_values={"['round']": np.float32(9.0)})
+    out = np.asarray(new["params"]["w"])
+    np.testing.assert_array_equal(out[2:6], np.asarray(state["params"]["w"]))
+    assert (out[[0, 1, 6, 7]] == 42.0).all()
+    assert fills["['params']['w']"] == [0, 1, 6, 7]
+    # Replicated override wins over the live host value.
+    assert float(new["round"]) == 9.0
+    client = [s for s in steps if s.kind == "client"][0]
+    assert client.rows == 8 and client.join_rows == 4
+
+
+def test_reshard_state_rejects_bad_row_map():
+    mesh, state = _mesh_state(4)
+    with pytest.raises(ValueError, match="row_map"):
+        reshard_state(state, dst_mesh=mesh, dst_clients=4, row_map=[0, 1])
+
+
+# ------------------------------------------------------------- controller
+
+
+def _ctl(tmp_path, idx=0, count=2, launch="L0", **kw):
+    return ReshardController(process_index=idx, process_count=count,
+                             launch_id=launch, restart_count=0,
+                             checkpoint_dir=str(tmp_path), **kw)
+
+
+def test_ack_roundtrip_and_timeout_degrades(tmp_path):
+    ctl = _ctl(tmp_path, ack_timeout=0.4)
+    ctl.publish_ack(0, "a", 3)
+    ctl.await_acks(0, "a", (0,))                      # own ack: immediate
+    with pytest.raises(ReshardFailed):
+        ctl.await_acks(0, "a", (0, 1))                # peer never acks
+    # Phase tags do not alias: the phase-a ack satisfies no phase-b wait.
+    with pytest.raises(ReshardFailed):
+        ctl.await_acks(0, "b", (0,))
+
+
+def test_spool_roundtrip_and_generation_fence(tmp_path):
+    ctl = _ctl(tmp_path)
+    join = {"['params']['w']": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    repl = {"['round']": np.float32(5.0)}
+    ctl.write_spool(1, join, repl, {"history": {"accuracy": [0.5]}})
+    j, r, control = ctl.read_spool(1)
+    np.testing.assert_array_equal(j["['params']['w']"],
+                                  join["['params']['w']"])
+    assert float(r["['round']"]) == 5.0
+    assert control["history"] == {"accuracy": [0.5]}
+    # Another launch generation must refuse this spool outright.
+    stale = _ctl(tmp_path, launch="L1")
+    with pytest.raises(ReshardFailed, match="another generation"):
+        stale.read_spool(1)
+
+
+def test_poll_plan_fires_once_and_not_after_restart(tmp_path):
+    spec = {"seed": 0, "faults": [{"kind": "preempt_notice", "round": 3,
+                                   "target_clients": 4,
+                                   "process_index": 1}]}
+    plan = FaultPlan.load(spec, num_clients=8, rounds=8)
+    ctl = ReshardController(plan=plan, process_index=0, process_count=2,
+                            launch_id="L0", restart_count=0,
+                            checkpoint_dir=str(tmp_path))
+    assert ctl.poll(0) is None and ctl.poll(1) is None
+    req = ctl.poll(2)                  # 1-based round 3 = 0-based loop-top 2
+    assert (req.mode, req.victim, req.target_clients) == ("shrink", 1, 4)
+    assert ctl.poll(2) is None         # once-only
+    # A gang restart must not replay the notice that just failed.
+    ctl2 = ReshardController(plan=plan, process_index=0, process_count=2,
+                             launch_id="L0", restart_count=1,
+                             checkpoint_dir=str(tmp_path))
+    assert all(ctl2.poll(r) is None for r in range(8))
+
+
+def test_signal_agreement_converges(tmp_path):
+    """Two processes see the notice at different loop-tops; both fire at
+    max(published) + 1 with the same victim."""
+    a, b = _ctl(tmp_path, idx=0), _ctl(tmp_path, idx=1)
+    a.request_signal("shrink")
+    assert a.poll(5) is None           # publishes round 5, waits for peer
+    b.request_signal("shrink")
+    assert b.poll(6) is None           # publishes round 6
+    assert a.poll(6) is None           # agreed round is 7, not yet reached
+    ra, rb = a.poll(7), b.poll(7)
+    assert ra is not None and rb is not None
+    assert (ra.mode, ra.victim, ra.round) == (rb.mode, rb.victim, rb.round)
+    assert ra.round == 7 and ra.mode == "shrink" and ra.victim == 1
+
+
+def test_committed_bookkeeping_and_finish(tmp_path):
+    ctl = _ctl(tmp_path, idx=0, count=2)
+    ctl.committed("shrink", 1)
+    assert ctl.active == (0,) and ctl.parked_victim == 1 and ctl.seq == 1
+    ctl.finish()
+    done = os.path.join(str(tmp_path), ".reshard", "run_done")
+    with open(done) as fh:
+        assert json.load(fh)["launch"] == "L0"
+    ctl.committed("grow", 1)
+    assert ctl.active == (0, 1) and ctl.parked_victim is None
+    # Nobody parked: finish is a no-op (marker already consumed/removed).
+    os.remove(done)
+    ctl.finish()
+    assert not os.path.exists(done)
+
+
+def test_finish_is_leader_only(tmp_path):
+    ctl = _ctl(tmp_path, idx=1, count=3)
+    ctl.committed("shrink", 2)         # active (0, 1): leader is 0, not us
+    ctl.finish()
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), ".reshard", "run_done"))
+
+
+# ------------------------------------------- integrated single-process plan
+
+
+def _cfg(rounds=6, fault_plan=None, events=None):
+    return ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=512),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=rounds, termination_patience=10,
+                      tolerance=1e-12),
+        run=RunConfig(eval_test_every=0, fault_plan=fault_plan,
+                      telemetry=TelemetryConfig(events_path=events)),
+    )
+
+
+def test_single_process_shrink_grow_no_restart(tmp_path):
+    """One run: 8 clients -> shrink to 4 at round 3 -> grow back to 8 at
+    round 5 -> finish all 6 rounds. The pre-shrink prefix is bitwise the
+    no-fault baseline's; the shrink round visibly changes the cohort."""
+    ev = str(tmp_path / "ev.jsonl")
+    plan = json.dumps({"seed": 0, "faults": [
+        {"kind": "preempt_notice", "round": 3, "target_clients": 4},
+        {"kind": "preempt_cancel", "round": 5},
+    ]})
+    res = run_experiment(_cfg(fault_plan=plan, events=ev), verbose=False)
+    base = run_experiment(_cfg(), verbose=False)
+    assert res.rounds_run == 6 and not res.diverged
+    acc, bacc = res.global_metrics["accuracy"], base.global_metrics["accuracy"]
+    assert acc[:2] == bacc[:2]                    # bitwise pre-shrink prefix
+    assert acc[2] != bacc[2]                      # 4-client rounds differ
+    with open(ev) as fh:
+        events = [json.loads(ln) for ln in fh if ln.strip()]
+    done = [e for e in events if e["kind"] == "reshard_done"]
+    assert [e["payload"]["mode"] for e in done] == ["shrink", "grow"]
+    assert done[0]["payload"]["target"] == 4
+    assert done[1]["payload"]["target"] == 8
+    assert all(s["join_rows"] == 0 for s in done[0]["payload"]["steps"])
+    assert any(s["join_rows"] > 0 for s in done[1]["payload"]["steps"])
+
+
+def test_shrink_rebuilt_step_matches_income4_audit_golden():
+    """The audit-gate tie-in (tests/test_audit_gate.py): the round step a
+    live shrink REBUILDS (income-8 topology minus half its mesh, data
+    repacked through the partition view) must compile to exactly the
+    collective schedule pinned in the committed income-4 golden — a
+    reshard can never silently change the schedule contract."""
+    from fedtpu.analysis.program import audit_step_summary
+    from fedtpu.data import load_dataset
+    from fedtpu.parallel.round import AUDIT_SPEC
+
+    cfg8 = ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256),
+        shard=ShardConfig(num_clients=8),
+        fed=FedConfig(rounds=5))
+    ds = load_dataset(cfg8.data)
+    exp8 = build_experiment(cfg8, ds)
+    dst = submesh(exp8.mesh, num_clients=4)
+    cfg4 = dataclasses.replace(
+        cfg8, shard=dataclasses.replace(cfg8.shard, num_clients=4,
+                                        partition_clients=8,
+                                        partition_offset=0))
+    exp4 = build_experiment(cfg4, ds, mesh=dst)
+    summary = audit_step_summary(
+        exp4.make_step(1), (exp4.state, exp4.batch),
+        donate_argnums=AUDIT_SPEC["donate_argnums"])
+    golden_path = os.path.join(os.path.dirname(__file__), "goldens",
+                               "audit_income-4.json")
+    with open(golden_path, encoding="utf-8") as fh:
+        golden = json.load(fh)["engines"]["sync"]
+    assert summary["schedule_digest"] == golden["schedule_digest"]
+    assert summary["comm_bytes_per_round"] == golden["comm_bytes_per_round"]
+    assert summary["findings"] == 0
