@@ -1,0 +1,26 @@
+"""SLO-driven autoscaling control plane (ROADMAP item 4).
+
+Three layers, strictly stacked so every one is testable on its own:
+
+- :mod:`fedtpu.autoscale.signals` — a :class:`SignalBus` folds live
+  telemetry (serving ``stats`` payloads, heartbeat files, cohort
+  prefetch gauges) into a versioned, immutable :class:`Snapshot` per
+  control tick.
+- :mod:`fedtpu.autoscale.policy` — pure virtual-clock policy functions
+  map a snapshot to an ordered decision list (``grow`` / ``shrink`` /
+  ``set_cohort_size`` / ``set_tick_cadence`` / ``pre_drain`` /
+  ``hold``). Pure in (policy config, snapshot stream): the decision
+  sequence is bitwise-replayable.
+- :mod:`fedtpu.autoscale.controller` — the actuator: executes decisions
+  through the reshard protocol (SIGUSR1/SIGUSR2 to the gang
+  supervisor), the serving engine's ``configure`` / ``pre_drain``
+  protocol ops, and a deterministic virtual-time simulator whose
+  decision JSONL is golden-gated in tier-1.
+
+Import the submodules directly (``from fedtpu.autoscale import policy``);
+this package initializer deliberately imports nothing, so jax-free
+callers (signals/policy, the simulator) never pull in the serving
+protocol client transitively.
+"""
+
+__all__ = ["signals", "policy", "controller"]

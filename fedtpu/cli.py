@@ -16,6 +16,9 @@ Subcommands:
              AST, never touches a backend
     check  — runtime guard: prove the round step is retrace-free under
              jax.transfer_guard / the recompile sentinel
+    autoscale — SLO-driven autoscaling control plane: poll live signals
+             (or replay a trace in --simulate) and act through the
+             reshard/serving knobs (docs/autoscale.md)
 """
 
 from __future__ import annotations
@@ -597,13 +600,24 @@ def build_parser() -> argparse.ArgumentParser:
                               help="aggregate a telemetry events JSONL "
                                    "(phase breakdown, round cadence, "
                                    "staleness, counters)")
-    report_p.add_argument("events", help="events JSONL written via --events")
+    report_p.add_argument("events", nargs="+",
+                          help="events JSONL path(s) written via --events; "
+                               "several sinks (serve + gang + controller) "
+                               "merge into one combined view plus a "
+                               "per-source admission/SLO breakdown")
     report_p.add_argument("--format", choices=["text", "json"],
                           default="text",
                           help="report rendering (default text)")
     report_p.add_argument("--prometheus", default=None, metavar="PATH",
                           help="also write a Prometheus text-exposition "
                                "snapshot of the aggregated log here")
+    report_p.add_argument("--heartbeat", default=None, metavar="FILE",
+                          help="supervisor heartbeat base path: adds live "
+                               "per-process status rows (serving/parked/"
+                               "stale/missing) to the resilience section")
+    report_p.add_argument("--num-processes", type=_positive_int, default=1,
+                          help="gang size for --heartbeat (per-process "
+                               "files <base>.p<i>; default 1)")
 
     # Static analysis: pure AST, no backend, no preset — safe in any
     # environment (CI lint gates, pre-commit).
@@ -658,6 +672,11 @@ def build_parser() -> argparse.ArgumentParser:
                               "over the package plus the jaxpr-level "
                               "program audit ('fedtpu audit') of the same "
                               "preset — folded into the exit code")
+    check_p.add_argument("--autoscale-sim", default=None, metavar="GOLDEN",
+                         help="also replay the pinned autoscale "
+                              "simulation and compare its decision "
+                              "sequence bitwise against this golden "
+                              "JSONL, folded into the exit code")
 
     # IR-level program audit: trace the real engines, extract and verify
     # the collective schedule, prove donation, account comm bytes
@@ -789,8 +808,9 @@ def build_parser() -> argparse.ArgumentParser:
                          help="comma-separated subset of: sigkill, "
                               "preempt, nan_rollback, dropout, straggler, "
                               "mp_kill_worker, mp_kill_coordinator, "
-                              "mp_hang, mp_preempt (default: all; the "
-                              "mp_* rows run a 2-process gang)")
+                              "mp_hang, mp_preempt, mp_autoscale_preempt "
+                              "(default: all; the mp_* rows run a "
+                              "2-process gang)")
     chaos_p.add_argument("--rounds", type=_positive_int, default=10,
                          help="rounds per scenario run (default 10)")
     chaos_p.add_argument("--num-clients", type=_positive_int, default=4,
@@ -950,6 +970,88 @@ def build_parser() -> argparse.ArgumentParser:
     load_p.add_argument("--quiet", action="store_true",
                         help="suppress the human-readable summary")
 
+    # SLO-driven autoscaling control plane (fedtpu.autoscale;
+    # docs/autoscale.md). jax-free: signals come over the serve socket +
+    # heartbeat files, actions go out as protocol ops and signals.
+    auto_p = sub.add_parser("autoscale",
+                            help="SLO-driven autoscaling control plane: "
+                                 "fold live signals into decisions and "
+                                 "act through the reshard/serving knobs "
+                                 "(docs/autoscale.md)")
+    auto_p.add_argument("--simulate", action="store_true",
+                        help="replay a seeded bursty trace against the "
+                             "policy in pure virtual time instead of "
+                             "attaching to a live deployment; the decision "
+                             "sequence is a bitwise-comparable artifact")
+    auto_p.add_argument("--trace", default=None, metavar="JSONL",
+                        help="simulate against this arrival trace instead "
+                             "of the pinned synthetic one (the pinned one "
+                             "is the golden contract)")
+    auto_p.add_argument("--golden", default=None, metavar="PATH",
+                        help="compare the simulated decision sequence "
+                             "bitwise against this golden JSONL; any "
+                             "divergence fails the command")
+    auto_p.add_argument("--out", default=None, metavar="PATH",
+                        help="write the decision sequence JSONL here "
+                             "(golden (re)generation)")
+    auto_p.add_argument("--policy", default="threshold",
+                        help="policy name from the registry "
+                             "(default threshold)")
+    auto_p.add_argument("--objective", type=_positive_float, default=None,
+                        metavar="S",
+                        help="SLO objective on update-to-incorporation "
+                             "latency in virtual seconds (default 1.0)")
+    auto_p.add_argument("--error-budget", type=_positive_float,
+                        default=None,
+                        help="share of updates allowed past the objective "
+                             "(burn 1.0 = budget exactly consumed; "
+                             "default 0.1)")
+    auto_p.add_argument("--interval", type=_positive_float, default=None,
+                        metavar="S",
+                        help="control-loop interval (default 0.5; live "
+                             "mode polls at this wall-clock cadence, "
+                             "simulation ticks this much virtual time)")
+    auto_p.add_argument("--host", default="127.0.0.1",
+                        help="live: serve host (default 127.0.0.1)")
+    auto_p.add_argument("--port", type=_nonnegative_int, default=0,
+                        help="live: serve port (or use --port-file; "
+                             "0 = no serving signals/actions)")
+    auto_p.add_argument("--port-file", default=None, metavar="FILE",
+                        help="live: poll this file (written by serve "
+                             "--port-file) for the port")
+    auto_p.add_argument("--heartbeat", default=None, metavar="FILE",
+                        help="live: gang heartbeat base path (per-process "
+                             "files <base>.p<i>) for membership signals")
+    auto_p.add_argument("--num-processes", type=_positive_int, default=1,
+                        help="live: gang size behind --heartbeat")
+    auto_p.add_argument("--supervisor-pid", type=_nonnegative_int,
+                        default=0, metavar="PID",
+                        help="live: 'fedtpu supervise' parent to signal "
+                             "for grow/shrink (SIGUSR2/SIGUSR1; 0 = no "
+                             "gang actions)")
+    auto_p.add_argument("--notice-file", default=None, metavar="FILE",
+                        help="live: poll this JSON file ({\"victim\": p}) "
+                             "for preemption notices; each payload is "
+                             "acted on once (pre-drain + shrink)")
+    auto_p.add_argument("--spool-path", default=None, metavar="FILE",
+                        help="live: where the server spools pending "
+                             "updates on pre-drain (default: its "
+                             "checkpoint dir)")
+    auto_p.add_argument("--duration", type=_nonnegative_float, default=0.0,
+                        metavar="S",
+                        help="live: stop after this many wall seconds "
+                             "(0 = until interrupted)")
+    auto_p.add_argument("--stop-after-notice", action="store_true",
+                        help="live: exit once a preemption notice has "
+                             "been acted on (chaos drill mode)")
+    auto_p.add_argument("--events", default=None, metavar="JSONL",
+                        help="telemetry events sink (decision/act events; "
+                             "read back by 'fedtpu report')")
+    auto_p.add_argument("--json", action="store_true",
+                        help="print the summary as one JSON line")
+    auto_p.add_argument("--quiet", action="store_true",
+                        help="suppress status lines")
+
     sub.add_parser("presets", help="list shipped presets")
     return parser
 
@@ -1007,7 +1109,9 @@ def main(argv=None) -> int:
         # Before _apply_overrides: the report parser carries no --preset
         # (and must not — it reads a log, not a config).
         from fedtpu.telemetry.report import render_report
-        rendered, prom = render_report(args.events, fmt=args.format)
+        rendered, prom = render_report(args.events, fmt=args.format,
+                                       heartbeat=args.heartbeat,
+                                       process_count=args.num_processes)
         print(rendered)
         if args.prometheus:
             with open(args.prometheus, "w") as f:
@@ -1088,6 +1192,82 @@ def main(argv=None) -> int:
                   f"({summary['events_per_sec']:.0f} ev/s); "
                   f"admission: {summary['admission']}")
         return 0
+
+    if args.cmd == "autoscale":
+        # Before the platform pin: the control plane is jax-free — it
+        # reads signals over the serve socket / heartbeat files and acts
+        # through protocol ops and process signals, never a backend.
+        import dataclasses as _dc
+
+        from fedtpu.autoscale.controller import (LiveController,
+                                                 compare_decisions, simulate,
+                                                 write_decisions)
+        from fedtpu.config import AutoscaleConfig
+        from fedtpu.telemetry import make_tracer
+        acfg = AutoscaleConfig(policy=args.policy)
+        over = {}
+        if args.objective is not None:
+            over["objective_s"] = args.objective
+        if args.error_budget is not None:
+            over["error_budget"] = args.error_budget
+        if args.interval is not None:
+            over["control_interval_s"] = args.interval
+        if over:
+            acfg = _dc.replace(acfg, **over)
+        tracer = make_tracer(args.events)
+        try:
+            if args.simulate:
+                result = simulate(acfg, trace_path=args.trace,
+                                  tracer=tracer)
+                if args.out:
+                    write_decisions(args.out, result["lines"])
+                ok = True
+                if args.golden:
+                    cmp = compare_decisions(result["lines"], args.golden)
+                    ok = cmp["ok"]
+                if args.json:
+                    print(json.dumps({**result["summary"],
+                                      "ok": ok}, default=float))
+                elif not args.quiet:
+                    s = result["summary"]
+                    print(f"simulated {s['control_ticks']} control "
+                          f"tick(s) over {s['arrivals']} arrival(s): "
+                          f"admitted {s['admitted']}, incorporated "
+                          f"{s['incorporated']}, spooled {s['spooled']}, "
+                          f"capacity {s['capacity_end']}, decisions "
+                          f"{s['decisions']}")
+                    if args.out:
+                        print(f"decisions -> {args.out}")
+                    if args.golden:
+                        if ok:
+                            print(f"golden: matches {args.golden}")
+                        else:
+                            print(f"golden: {cmp['reason']} "
+                                  f"vs {args.golden}")
+                return 0 if ok else 1
+            port = args.port
+            if args.port_file:
+                from fedtpu.serving.loadgen import read_port_file
+                port = read_port_file(args.port_file)
+            ctl = LiveController(
+                acfg, host=args.host, port=port,
+                supervisor_pid=args.supervisor_pid,
+                heartbeat=args.heartbeat,
+                process_count=args.num_processes,
+                notice_file=args.notice_file,
+                spool_path=args.spool_path, tracer=tracer)
+            summary = ctl.run(duration_s=args.duration,
+                              interval_s=args.interval,
+                              stop_after_notice=args.stop_after_notice)
+            if args.json:
+                print(json.dumps(summary, default=float))
+            elif not args.quiet:
+                print(f"autoscale: {summary['control_ticks']} control "
+                      f"tick(s) in {summary['wall_s']:.1f} s wall; "
+                      f"acted {summary['acted']}")
+            return 0
+        finally:
+            tracer.close()
 
     if args.cmd == "run" and getattr(args, "max_restarts", None):
         # Self-supervision shorthand: re-issue this exact run as a
@@ -1184,6 +1364,19 @@ def main(argv=None) -> int:
             }
             report["ok"] = (report["ok"] and audit["ok"]
                             and report["lint"]["clean"])
+        if args.autoscale_sim:
+            # Fold the pinned control-plane simulation into the check:
+            # the decision sequence must match the committed golden
+            # bitwise — policy drift fails the gate like a retrace.
+            from fedtpu.autoscale.controller import (compare_decisions,
+                                                     simulate)
+            sim = simulate()
+            cmp = compare_decisions(sim["lines"], args.autoscale_sim)
+            report["autoscale_sim"] = {
+                "ok": cmp["ok"], "reason": cmp["reason"],
+                "golden": args.autoscale_sim,
+                "control_ticks": sim["summary"]["control_ticks"]}
+            report["ok"] = report["ok"] and cmp["ok"]
         if args.json:
             print(json.dumps(report))
         else:
@@ -1197,6 +1390,9 @@ def main(argv=None) -> int:
             if "audit" in report:
                 print(f"audit: ok={report['audit']['ok']} "
                       f"digests={report['audit']['digests']}")
+            if "autoscale_sim" in report:
+                a = report["autoscale_sim"]
+                print(f"autoscale-sim: ok={a['ok']} ({a['reason']})")
             print(f"ok: {report['ok']}")
         return 0 if report["ok"] else 1
 

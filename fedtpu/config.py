@@ -428,6 +428,63 @@ class ServingConfig:
     data_classes: int = 2
     model_hidden: Tuple[int, ...] = (16, 8)
     seed: int = 0
+    # SLO objective on update-to-incorporation latency (virtual s) and
+    # the allowed violation share. Burn = violation_share/error_budget;
+    # 1.0 means the budget is consumed exactly as provisioned
+    # (fedtpu.autoscale.signals.slo_burn_from_hist).
+    slo_objective_s: float = 1.0
+    slo_error_budget: float = 0.1
+    # Sliding window (virtual s) for the admission stats the autoscale
+    # control plane reads off the `stats` protocol op.
+    admission_window_s: float = 10.0
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """`fedtpu autoscale` — the SLO-driven control plane
+    (fedtpu.autoscale; docs/autoscale.md).
+
+    Thresholds are read against :class:`fedtpu.autoscale.signals.
+    Snapshot` fields; the hysteresis/cooldown pair is what keeps the
+    default policy from flapping (a scale signal must persist for
+    ``hysteresis_ticks`` consecutive control ticks, and every action
+    opens a ``cooldown_ticks`` refractory window)."""
+
+    policy: str = "threshold"
+    # SLO fold (must mirror the serving side's objective to be
+    # meaningful; the simulator uses these directly).
+    objective_s: float = 1.0
+    error_budget: float = 0.1
+    control_interval_s: float = 0.5   # snapshot cadence (virtual s live+sim)
+    # Threshold knobs for the default policy.
+    backlog_high: int = 256           # pending depth that means overload
+    backlog_low: int = 32             # pending depth that means underload
+    burn_high: float = 1.0            # SLO burn >= this is overload
+    reject_high: float = 0.2          # window rate+backpressure reject share
+    hysteresis_ticks: int = 2
+    cooldown_ticks: int = 4
+    # Actuation bounds / targets.
+    min_capacity: int = 1             # gang floor (members)
+    max_capacity: int = 8             # gang ceiling (members)
+    cohort_high: int = 128            # set_cohort_size on scale-up
+    cohort_low: int = 32              # set_cohort_size on scale-down
+    tick_fast_s: float = 0.1          # set_tick_cadence on scale-up
+    tick_slow_s: float = 1.0          # set_tick_cadence on scale-down
+
+    def __post_init__(self):
+        if self.objective_s <= 0 or self.error_budget <= 0:
+            raise ValueError("objective_s and error_budget must be > 0")
+        if self.control_interval_s <= 0:
+            raise ValueError("control_interval_s must be > 0")
+        if self.backlog_low > self.backlog_high:
+            raise ValueError("backlog_low must be <= backlog_high")
+        if self.hysteresis_ticks < 1 or self.cooldown_ticks < 0:
+            raise ValueError("hysteresis_ticks >= 1 and "
+                             "cooldown_ticks >= 0 required")
+        if not (1 <= self.min_capacity <= self.max_capacity):
+            raise ValueError("need 1 <= min_capacity <= max_capacity")
+        if self.tick_fast_s <= 0 or self.tick_slow_s <= 0:
+            raise ValueError("tick cadences must be > 0")
 
 
 @dataclasses.dataclass(frozen=True)

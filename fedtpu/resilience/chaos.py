@@ -59,6 +59,22 @@ reshard in the event log, and a bitwise pre-notice history prefix
                   generation tags keep the dead reshard's records from
                   split-braining the resumed gang.
 
+The AUTOSCALE row closes the loop through the control plane
+(fedtpu.autoscale; docs/autoscale.md) instead of a fault plan: a
+``fedtpu serve`` ingestion front-end under driven load, a 2-process
+training gang, and the live ``fedtpu autoscale`` controller run side by
+side; the harness drops a preemption notice file and the CONTROLLER —
+not the harness — pre-drains the server's pending updates to a spool
+and fires the live shrink (SIGUSR1 through the gang supervisor):
+
+  mp_autoscale_preempt  Zero gang restarts, >= 1 completed reshard, a
+                        nonzero pre-drain spool, no lost admitted
+                        updates after the final drain (admitted ==
+                        incorporated, backlog 0), and SLO burn within
+                        ``AUTOSCALE_BURN_BUDGET``. No bitwise history
+                        bar: signal timing is wall-clock, so the
+                        reshard round legitimately varies run to run.
+
 "History" is the ``--metrics-jsonl`` per-round record with timing
 stripped. Restarted/rolled-back runs append re-executed rounds to the
 same sink, so the comparison takes the LAST record per round — exactly
@@ -83,7 +99,8 @@ from typing import List, Optional, Sequence
 
 SCENARIOS = ("sigkill", "preempt", "nan_rollback", "dropout", "straggler",
              "mp_kill_worker", "mp_kill_coordinator", "mp_hang",
-             "mp_preempt", "mp_shrink", "mp_grow", "mp_shrink_dead")
+             "mp_preempt", "mp_shrink", "mp_grow", "mp_shrink_dead",
+             "mp_autoscale_preempt")
 
 # The gang rows: 2 OS processes x 2 virtual CPU devices each, wired into
 # one jax.distributed runtime by `supervise --num-processes 2`. Their
@@ -95,6 +112,14 @@ MP_SCENARIOS = ("mp_kill_worker", "mp_kill_coordinator", "mp_hang",
 # The elastic subset: a preemption NOTICE instead of a kill — the gang
 # must resize itself live (fedtpu.resilience.reshard), not restart.
 RESHARD_SCENARIOS = ("mp_shrink", "mp_grow", "mp_shrink_dead")
+# The control-plane drill: serve + gang + live `fedtpu autoscale` side
+# by side. Not in MP_SCENARIOS — it needs no gang baseline (no bitwise
+# history bar: the shrink round depends on wall-clock signal timing).
+AUTOSCALE_SCENARIO = "mp_autoscale_preempt"
+# SLO-burn ceiling for the drill's final server stats: burn 1.0 means
+# the error budget was consumed exactly as provisioned; the drill
+# deliberately overloads + preempts, so it gets double budget.
+AUTOSCALE_BURN_BUDGET = 2.0
 MP_PROCESSES = 2
 MP_DEVICES_PER_PROC = 2
 # Watchdog budget for the gang rows: far above the tiny CPU job's
@@ -203,9 +228,185 @@ def _resilience(events_path: str) -> dict:
     return aggregate(events, malformed=bad).get("resilience") or {}
 
 
+def _wait_for_round(path: str, rnd: int, proc, timeout_s: float) -> bool:
+    """Poll a metrics JSONL until some record reaches round ``rnd``; False
+    when ``proc`` exits or the budget runs out first."""
+    import time
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if _history(path) and max(_history(path)) >= rnd:
+            return True
+        if proc.poll() is not None:
+            return False
+        time.sleep(0.05)
+    return False
+
+
+def _run_autoscale_preempt(workdir: str, rounds: int, num_clients: int,
+                           platform: str, timeout: int) -> dict:
+    """The control-plane drill (module docstring ``mp_autoscale_preempt``):
+    serve under driven load + a 2-process gang + the live controller.
+    The harness only writes the notice file; every action — the
+    pre-drain spool and the SIGUSR1 shrink — is the controller's."""
+    import signal as _signal
+    import time
+
+    from fedtpu.serving.protocol import Connection
+    from fedtpu.serving.traces import synthesize_trace, write_trace
+    name = AUTOSCALE_SCENARIO
+    trace = os.path.join(workdir, f"{name}.trace.jsonl")
+    port_file = os.path.join(workdir, f"{name}.port")
+    notice = os.path.join(workdir, f"{name}.notice.json")
+    spool = os.path.join(workdir, f"{name}.spool.jsonl")
+    hb = os.path.join(workdir, f"{name}.hb")
+    serve_events = os.path.join(workdir, f"{name}.serve.events.jsonl")
+    ctl_events = os.path.join(workdir, f"{name}.ctl.events.jsonl")
+    header, t, user, lat = synthesize_trace(200, 3000, 20.0, seed=3)
+    write_trace(trace, header, t, user, lat)
+
+    row = {"scenario": name, "rc": -1, "survived": False,
+           "history_match": True, "faults": 0, "restarts": 0,
+           "rollbacks": 0, "gang_restarts": 0, "collective_hangs": 0,
+           "reshards": 0, "reshard_failures": 0, "spooled": 0,
+           "acted": {}, "backlog": None, "slo_burn": None,
+           "lost_updates": None, "ok": False}
+    serve = gang = None
+    stderr_parts = []
+    try:
+        serve = subprocess.Popen(
+            [sys.executable, "-m", "fedtpu.cli", "serve",
+             "--platform", platform, "--port-file", port_file,
+             "--checkpoint-dir", os.path.join(workdir, f"{name}.serve.ck"),
+             "--events", serve_events, "--quiet", "--json"],
+            env=_child_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        # Driven load: blast the whole trace, NO drain — the pending
+        # backlog must still be there for the controller's pre-drain.
+        load = subprocess.run(
+            [sys.executable, "-m", "fedtpu.cli", "loadgen", trace,
+             "--port-file", port_file, "--no-drain", "--quiet"],
+            env=_child_env(), capture_output=True, text=True,
+            timeout=timeout)
+        if load.returncode != 0:
+            row["error"] = "loadgen failed"
+            stderr_parts.append(load.stderr or "")
+            return row
+
+        # Straggler pacing on every post-warmup round keeps the tiny CPU
+        # job alive long enough for the wall-clock notice to land with
+        # rounds to spare after the shrink.
+        pace = [{"kind": "straggler", "round": r, "clients": [0],
+                 "delay_s": 0.4} for r in range(2, rounds + 1)]
+        run_args = _run_args(workdir, name, rounds, num_clients, platform)
+        run_args += ["--fault-plan", json.dumps({"seed": 0, "faults": pace}),
+                     "--checkpoint-dir", os.path.join(workdir, f"{name}.ck"),
+                     "--checkpoint-every", "2",
+                     "--collective-timeout", str(MP_COLLECTIVE_TIMEOUT)]
+        gang = subprocess.Popen(
+            [sys.executable, "-m", "fedtpu.cli", "supervise",
+             "--heartbeat", hb, "--num-processes", str(MP_PROCESSES),
+             "--max-restarts", "2", "--grace", "10",
+             "--events", os.path.join(workdir, f"{name}.events.jsonl"),
+             "--", *run_args],
+            env=_mp_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        # The notice goes down only once the gang is mid-run (its reshard
+        # signal handlers install before round 0) — writing it FIRST
+        # means the controller's very first control tick sees it, so the
+        # drill never depends on threshold-policy dynamics.
+        if not _wait_for_round(
+                os.path.join(workdir, f"{name}.metrics.jsonl"), 2, gang,
+                timeout):
+            row["error"] = "gang never reached round 2"
+            return row
+        tmp = f"{notice}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump({"victim": 1}, fh)
+        os.replace(tmp, notice)
+        ctl = subprocess.run(
+            [sys.executable, "-m", "fedtpu.cli", "autoscale",
+             "--port-file", port_file, "--heartbeat", hb,
+             "--num-processes", str(MP_PROCESSES),
+             "--supervisor-pid", str(gang.pid), "--notice-file", notice,
+             "--spool-path", spool, "--interval", "0.2",
+             "--stop-after-notice", "--events", ctl_events,
+             "--quiet", "--json"],
+            env=_child_env(), capture_output=True, text=True,
+            timeout=timeout)
+        if ctl.returncode != 0:
+            row["error"] = "controller failed"
+            stderr_parts.append(ctl.stderr or "")
+            return row
+        try:
+            gang_rc = gang.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            gang.kill()
+            row["error"] = "gang timed out after the shrink"
+            return row
+        row["rc"] = gang_rc
+
+        # Final drain + machine-readable signals straight off the wire:
+        # the no-lost-updates and SLO-burn bars read the same stats
+        # block the controller polls.
+        with Connection("127.0.0.1",
+                        int(open(port_file).read().strip())) as conn:
+            conn.hello()
+            conn.request({"op": "drain"})
+            signals = conn.request({"op": "stats"}).get("signals") or {}
+        serve.send_signal(_signal.SIGTERM)
+        serve_rc = serve.wait(timeout=60)
+        row["slo_burn"] = signals.get("slo_burn")
+        row["lost_updates"] = (int(signals.get("admitted") or 0)
+                               - int(signals.get("incorporated") or 0))
+        res = _resilience(os.path.join(workdir, f"{name}.events.jsonl"))
+        row["restarts"] = res.get("restarts") or 0
+        row["gang_restarts"] = res.get("gang_restarts") or 0
+        row["reshards"] = len(res.get("reshards") or [])
+        row["reshard_failures"] = len(res.get("reshard_failures") or [])
+        from fedtpu.telemetry.report import aggregate, load_events
+        ev, bad = load_events(serve_events)
+        asc = aggregate(ev, malformed=bad).get("autoscale") or {}
+        row["spooled"] = sum(int(p.get("spooled") or 0)
+                             for p in asc.get("serve_pre_drains") or [])
+        ev, bad = load_events(ctl_events)
+        acted = (aggregate(ev, malformed=bad).get("autoscale")
+                 or {}).get("acted") or {}
+        row["acted"] = dict(acted)
+        row["backlog"] = int(signals.get("backlog") or 0)
+        row["survived"] = gang_rc == 0 and serve_rc in (0, 75)
+        row["ok"] = (row["survived"]
+                     and row["gang_restarts"] == 0
+                     and row["reshards"] >= 1
+                     and row["reshard_failures"] == 0
+                     and row["spooled"] > 0
+                     and row["lost_updates"] == 0
+                     and (signals.get("backlog") or 0) == 0
+                     and acted.get("pre_drain", 0) >= 1
+                     and acted.get("shrink", 0) >= 1
+                     and row["slo_burn"] is not None
+                     and row["slo_burn"] <= AUTOSCALE_BURN_BUDGET)
+        if not row["ok"]:
+            stderr_parts.append((gang.stderr.read() or "")
+                                if gang.stderr else "")
+        return row
+    except (subprocess.TimeoutExpired, OSError, ConnectionError) as e:
+        row["error"] = f"{type(e).__name__}: {e}"
+        return row
+    finally:
+        for proc in (gang, serve):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        if stderr_parts:
+            row["stderr_tail"] = "\n".join(stderr_parts)[-2000:]
+
+
 def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
                  num_clients: int, platform: str, timeout: int) -> dict:
     """One scenario run + verdict row (see module docstring for bars)."""
+    if name == AUTOSCALE_SCENARIO:
+        return _run_autoscale_preempt(workdir, rounds, num_clients,
+                                      platform, timeout)
     ck = os.path.join(workdir, f"{name}.ck")
     mp = name in MP_SCENARIOS
     reshard = name in RESHARD_SCENARIOS
@@ -342,14 +543,15 @@ def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
                     "scenarios": [], "workdir": wd}
         baseline = _history(os.path.join(wd, "baseline.metrics.jsonl"))
 
+        dev = MP_PROCESSES * MP_DEVICES_PER_PROC
+        if (any(n in MP_SCENARIOS or n == AUTOSCALE_SCENARIO
+                for n in names) and num_clients % dev):
+            raise ValueError(
+                f"gang scenarios need --num-clients divisible by "
+                f"{dev} ({MP_PROCESSES} processes x "
+                f"{MP_DEVICES_PER_PROC} devices); got {num_clients}")
         mp_baseline = None
         if any(n in MP_SCENARIOS for n in names):
-            dev = MP_PROCESSES * MP_DEVICES_PER_PROC
-            if num_clients % dev:
-                raise ValueError(
-                    f"gang scenarios need --num-clients divisible by "
-                    f"{dev} ({MP_PROCESSES} processes x "
-                    f"{MP_DEVICES_PER_PROC} devices); got {num_clients}")
             if verbose:
                 print(f"[chaos] gang baseline ({MP_PROCESSES} processes)"
                       f" in {wd}", flush=True)
@@ -388,6 +590,12 @@ def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
                 if name in RESHARD_SCENARIOS:
                     gang += (f" reshards={row['reshards']} "
                              f"reshard_failures={row['reshard_failures']}")
+                if name == AUTOSCALE_SCENARIO:
+                    gang += (f" gang_restarts={row['gang_restarts']} "
+                             f"reshards={row['reshards']} "
+                             f"spooled={row['spooled']} "
+                             f"lost_updates={row['lost_updates']} "
+                             f"slo_burn={row['slo_burn']}")
                 print(f"[chaos]   {name}: {status} rc={row['rc']} "
                       f"survived={row['survived']} "
                       f"history_match={row['history_match']} "

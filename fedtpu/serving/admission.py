@@ -25,13 +25,18 @@ hardest first, and the order is part of the contract (tests pin it):
 
 Per-verdict counters land in the shared MetricsRegistry under
 ``admission_<verdict>`` so they flow through the normal ``counters``
-snapshot into ``fedtpu report``.
+snapshot into ``fedtpu report``. The controller additionally keeps a
+sliding window (``window_s`` of virtual time) over its own verdict
+stream — :meth:`AdmissionController.window_rates` — so the autoscale
+control plane reads per-verdict rates off the SAME bookkeeping path the
+cumulative counters use, never a second tally that could drift.
 
 No jax in this module — admission is pure host bookkeeping.
 """
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
 from typing import Optional
 
@@ -101,12 +106,15 @@ class AdmissionPolicy:
     max_pending: int = 0           # queue-depth cutoff; 0 = off
     stale_deprioritize: int = 4    # versions behind => deprioritize
     stale_reject: int = 16         # versions behind => reject
+    window_s: float = 10.0         # sliding stats window (virtual s)
 
     def __post_init__(self):
         if self.stale_reject < self.stale_deprioritize:
             raise ValueError("stale_reject must be >= stale_deprioritize")
         if self.max_pending < 0:
             raise ValueError("max_pending must be >= 0")
+        if self.window_s <= 0:
+            raise ValueError("window_s must be > 0")
 
 
 class AdmissionController:
@@ -118,6 +126,10 @@ class AdmissionController:
         self.registry = registry
         self._bucket = TokenBucket(policy.rate_limit, policy.rate_burst)
         self.counts = {v: 0 for v in VERDICTS}
+        # Sliding window over (virtual_t, verdict) — fed by the same
+        # `_count` call the cumulative counters use. Not checkpointed:
+        # a resumed controller's rates warm back up over one window_s.
+        self._window: deque = deque()
 
     def decide(self, now: float, staleness: int, pending: int) -> str:
         """Verdict for an update arriving at virtual time ``now`` whose
@@ -125,20 +137,42 @@ class AdmissionController:
         admitted updates are still waiting for incorporation."""
         p = self.policy
         if not self._bucket.take(now):
-            return self._count(REJECT_RATE)
+            return self._count(REJECT_RATE, now)
         if p.max_pending and pending >= p.max_pending:
-            return self._count(REJECT_BACKPRESSURE)
+            return self._count(REJECT_BACKPRESSURE, now)
         if staleness > p.stale_reject:
-            return self._count(REJECT_STALE)
+            return self._count(REJECT_STALE, now)
         if staleness > p.stale_deprioritize:
-            return self._count(DEPRIORITIZE)
-        return self._count(ACCEPT)
+            return self._count(DEPRIORITIZE, now)
+        return self._count(ACCEPT, now)
 
-    def _count(self, verdict: str) -> str:
+    def _count(self, verdict: str, now: float = 0.0) -> str:
         self.counts[verdict] += 1
+        self._window.append((now, verdict))
+        self._evict(now)
         if self.registry is not None:
             self.registry.counter("admission_" + verdict).inc()
         return verdict
+
+    def _evict(self, now: float) -> None:
+        cutoff = now - self.policy.window_s
+        while self._window and self._window[0][0] < cutoff:
+            self._window.popleft()
+
+    def window_rates(self, now: Optional[float] = None) -> dict:
+        """Per-verdict share of the decisions inside the sliding window
+        ending at virtual time ``now`` (default: the newest decision's
+        timestamp). Shares of an empty window are all 0.0."""
+        if now is not None:
+            self._evict(now)
+        total = len(self._window)
+        tally = {v: 0 for v in VERDICTS}
+        for _, verdict in self._window:
+            tally[verdict] += 1
+        return {"window_s": self.policy.window_s,
+                "decisions": total,
+                "rates": {v: (tally[v] / total if total else 0.0)
+                          for v in VERDICTS}}
 
     # ------------------------------------------------------------------
     # checkpoint support (fedtpu.serving.engine persists this so a

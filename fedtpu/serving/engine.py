@@ -237,10 +237,15 @@ class ServingEngine:
                             rate_burst=cfg.rate_burst,
                             max_pending=cfg.max_pending,
                             stale_deprioritize=cfg.stale_deprioritize,
-                            stale_reject=cfg.stale_reject),
+                            stale_reject=cfg.stale_reject,
+                            window_s=getattr(cfg, "admission_window_s",
+                                             10.0)),
             registry=self.registry)
         self.clock = EngineClock(tick_interval_s=cfg.tick_interval_s)
         self.flush_every = int(cfg.flush_every)
+        # Default landing spot for pre_drain() spools (run_server points
+        # this at the checkpoint dir); None = caller must pass a path.
+        self.spool_dir: Optional[str] = None
 
         # The cohort's training fixture: synthetic income-shaped shards,
         # one per slot — serving exercises the ingestion/tick machinery,
@@ -503,8 +508,92 @@ class ServingEngine:
                                         if self.latencies else None),
             "wall_s": wall,
             "rounds_per_sec": (self.tick_count / wall) if wall > 0 else 0.0,
+            "signals": self.signals(),
         }
         return out
+
+    def signals(self) -> dict:
+        """The machine-readable block the autoscale control plane polls
+        through the ``stats`` protocol op: backlog depth, sliding-window
+        per-verdict rates (straight off the AdmissionController's own
+        window — no second tally), and SLO burn computed from the
+        cumulative update-to-incorporation histogram against the
+        configured objective. Shapes match what
+        :meth:`fedtpu.autoscale.signals.SignalBus.fold` consumes."""
+        from fedtpu.autoscale.signals import slo_burn_from_hist
+        win = self.admission.window_rates(self.clock.now)
+        admitted = sum(self.admission.counts[v] for v in ADMITTED)
+        return {
+            "backlog": len(self.pending),
+            "buffered": float(self.nbuf_host),
+            "incorporated": self.incorporated,
+            "admitted": admitted,
+            "window_s": win["window_s"],
+            "window_decisions": win["decisions"],
+            "rates": win["rates"],
+            "slo_burn": slo_burn_from_hist(
+                self._lat_hist.to_dict(),
+                getattr(self.cfg, "slo_objective_s", 1.0),
+                getattr(self.cfg, "slo_error_budget", 0.1)),
+            "tick_interval_s": self.clock.tick_interval_s,
+            "flush_every": self.flush_every,
+        }
+
+    def configure(self, tick_interval_s: Optional[float] = None,
+                  flush_every: Optional[int] = None) -> dict:
+        """Autoscale knob actuation: retarget the tick cadence and/or the
+        count-driven flush threshold mid-run. The time-driven schedule is
+        re-anchored at the current virtual time (the next firing is one
+        NEW interval from now); 0 disables that trigger, matching the
+        config semantics. Returns the applied values."""
+        if tick_interval_s is not None:
+            v = float(tick_interval_s)
+            if v < 0:
+                raise ValueError("tick_interval_s must be >= 0")
+            self.clock.tick_interval_s = v
+            self.clock.next_fire = self.clock.now + v
+        if flush_every is not None:
+            n = int(flush_every)
+            if n < 0:
+                raise ValueError("flush_every must be >= 0")
+            self.flush_every = n
+            if n and self._eligible_count() >= n:
+                self._tick(self.clock.now)
+        applied = {"tick_interval_s": self.clock.tick_interval_s,
+                   "flush_every": self.flush_every}
+        self.tracer.event("serve_configure", round=self.tick_count,
+                          **applied)
+        return applied
+
+    def pre_drain(self, path: Optional[str] = None):
+        """Preemption pre-drain: spool every pending (admitted, not yet
+        incorporated) update to ``path`` as canonical JSONL — the
+        durability copy an autoscale controller takes BEFORE a capacity
+        loss, so a preemption deadline cannot lose admitted work. The
+        queue itself is untouched (entries still incorporate normally if
+        the engine survives; a successor replays the spool if it does
+        not). Returns ``(count, path)``. Atomic tmp+rename, same
+        convention as heartbeats."""
+        import json
+        import os
+        if path is None:
+            if not self.spool_dir:
+                raise ValueError("pre_drain needs a path (no spool_dir "
+                                 "configured)")
+            path = os.path.join(self.spool_dir, "predrain.jsonl")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for p in self.pending:
+                fh.write(json.dumps(
+                    {"t": p.t, "user": p.user, "elig_tick": p.elig_tick},
+                    sort_keys=True, separators=(",", ":")) + "\n")
+        os.replace(tmp, path)
+        n = len(self.pending)
+        self.registry.counter("serve_pre_drains").inc()
+        self.tracer.event("serve_pre_drain", round=self.tick_count,
+                          spooled=n, path=path)
+        return n, path
 
     def emit_summary(self) -> dict:
         s = self.summary()

@@ -15,6 +15,14 @@ Client -> server ops:
             "tick": k}
     {"op": "stats"}
         -> {"op": "stats", ...engine/admission snapshot...}
+           (includes the machine-readable "signals" block the autoscale
+           control plane polls: backlog, window rates, SLO burn)
+    {"op": "configure", "tick_interval_s": 0.1, "flush_every": 64}
+        -> {"op": "configured", "tick_interval_s": ..., "flush_every": ...}
+           (autoscale knob actuation; omitted/null fields are unchanged)
+    {"op": "pre_drain"[, "path": "..."]}
+        -> {"op": "pre_drained", "spooled": n, "path": "..."}
+           (spool the pending updates to disk ahead of a capacity loss)
     {"op": "drain"}
         -> {"op": "drained", "tick": k, "incorporated": n}
 

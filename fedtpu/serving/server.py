@@ -95,6 +95,20 @@ def _handle(engine: ServingEngine, msg: dict) -> dict:
                 "version": engine.version, "tick": engine.tick_count}
     if op == "stats":
         return {"op": "stats", **engine.summary()}
+    if op == "configure":
+        try:
+            applied = engine.configure(
+                tick_interval_s=msg.get("tick_interval_s"),
+                flush_every=msg.get("flush_every"))
+        except (TypeError, ValueError) as e:
+            return protocol.error_msg(f"bad configure frame: {e}")
+        return {"op": "configured", **applied}
+    if op == "pre_drain":
+        try:
+            spooled, path = engine.pre_drain(msg.get("path"))
+        except (TypeError, ValueError, OSError) as e:
+            return protocol.error_msg(f"pre_drain failed: {e}")
+        return {"op": "pre_drained", "spooled": spooled, "path": path}
     if op == "drain":
         n = engine.drain()
         return {"op": "drained", "tick": engine.tick_count,
@@ -145,6 +159,8 @@ def run_server(cfg, *, events: Optional[str] = None,
     tracer = make_tracer(events)
     log = TelemetryLogger(verbose=verbose, tracer=tracer)
     engine = ServingEngine(cfg, registry=registry, tracer=tracer)
+    if checkpoint_dir:
+        engine.spool_dir = checkpoint_dir
     if resume and checkpoint_dir:
         from fedtpu.orchestration.checkpoint import latest_step
         if latest_step(checkpoint_dir) is not None:

@@ -87,6 +87,13 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     cohort_config: Optional[dict] = None
     cohort_summary: Optional[dict] = None
     cohort_stall_s = 0.0
+    autoscale_ticks = 0
+    autoscale_kinds: dict = {}
+    autoscale_acts: dict = {}
+    autoscale_pre_drains: List[dict] = []
+    autoscale_summary: Optional[dict] = None
+    serve_pre_drains: List[dict] = []
+    serve_configures = 0
     for e in events:
         v = e.get("v")
         if isinstance(v, int) and v > EVENT_SCHEMA_VERSION:
@@ -180,6 +187,25 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             cohort_stall_s += float(payload.get("prefetch_stall_s") or 0.0)
         elif kind == "cohort_summary":
             cohort_summary = payload
+        # Autoscale timeline (fedtpu.autoscale; docs/autoscale.md). One
+        # decision event per control tick; act events record what the
+        # controller actually did to the deployment.
+        elif kind == "autoscale_decision":
+            autoscale_ticks += 1
+            for d in payload.get("decisions") or []:
+                dk = d.get("kind")
+                autoscale_kinds[dk] = autoscale_kinds.get(dk, 0) + 1
+        elif kind == "autoscale_act":
+            ak = payload.get("decision")
+            autoscale_acts[ak] = autoscale_acts.get(ak, 0) + 1
+        elif kind == "autoscale_pre_drain":
+            autoscale_pre_drains.append(payload)
+        elif kind == "autoscale_summary":
+            autoscale_summary = payload
+        elif kind == "serve_pre_drain":
+            serve_pre_drains.append({"tick": e.get("round"), **payload})
+        elif kind == "serve_configure":
+            serve_configures += 1
 
     out: dict = {
         "events_total": len(events),
@@ -195,8 +221,20 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         "resilience": None,
         "serving": None,
         "cohort": None,
+        "autoscale": None,
         "static_analysis": None,
     }
+    if (autoscale_ticks or autoscale_acts or autoscale_summary
+            or autoscale_pre_drains or serve_pre_drains or serve_configures):
+        out["autoscale"] = {
+            "control_ticks": autoscale_ticks,
+            "decisions": dict(sorted(autoscale_kinds.items())),
+            "acted": dict(sorted(autoscale_acts.items())),
+            "pre_drains": autoscale_pre_drains,
+            "serve_pre_drains": serve_pre_drains,
+            "serve_configures": serve_configures,
+            "summary": autoscale_summary,
+        }
     if serve_ticks or serve_summary or starvation:
         out["serving"] = {
             "ticks": serve_ticks,
@@ -375,6 +413,13 @@ def render_text(agg: dict) -> str:
             se = res["supervisor_exit"]
             lines.append(f"  supervisor exit: rc={se.get('rc')} "
                          f"reason={se.get('reason')}")
+    hbs = agg.get("heartbeats")
+    if hbs:
+        if not res:
+            lines.append("resilience:")
+        for hb in hbs:
+            lines.append(f"  heartbeat p{hb.get('process')}: "
+                         f"{hb.get('status')}")
     srv = agg.get("serving")
     if srv:
         lines.append("serving:")
@@ -428,6 +473,49 @@ def render_text(agg: dict) -> str:
                          f"stall(s), "
                          f"{coh.get('prefetch_stall_s_total', 0.0):.3f} s "
                          "stalled total")
+    asc = agg.get("autoscale")
+    if asc:
+        lines.append("autoscale:")
+        dec = ", ".join(f"{k}={v}" for k, v in
+                        sorted((asc.get("decisions") or {}).items()))
+        lines.append(f"  control ticks: {asc.get('control_ticks')}"
+                     + (f" ({dec})" if dec else ""))
+        act = ", ".join(f"{k}={v}" for k, v in
+                        sorted((asc.get("acted") or {}).items()))
+        if act:
+            lines.append(f"  acted: {act}")
+        for pd in asc.get("pre_drains") or []:
+            lines.append(f"  pre-drain victim p{pd.get('victim')}: "
+                         f"{pd.get('spooled')} update(s) spooled "
+                         f"-> {pd.get('path')}")
+        for pd in asc.get("serve_pre_drains") or []:
+            lines.append(f"  server spool @ tick {pd.get('tick')}: "
+                         f"{pd.get('spooled')} update(s) -> "
+                         f"{pd.get('path')}")
+        if asc.get("serve_configures"):
+            lines.append(f"  server reconfigures: "
+                         f"{asc['serve_configures']}")
+        summ = asc.get("summary")
+        if summ:
+            lines.append("  summary: " + ", ".join(
+                f"{k}={summ[k]}" for k in sorted(summ)
+                if not isinstance(summ[k], (dict, list))))
+    srcs = agg.get("sources")
+    if srcs:
+        lines.append("per-source view:")
+        for s in srcs:
+            lines.append(f"  {s['path']}: {s['events']} event(s)")
+            adm = s.get("admission")
+            if adm:
+                lines.append("    admission: " + ", ".join(
+                    f"{k}={adm[k]:g}" for k in sorted(adm)))
+            lat = s.get("update_to_incorporation")
+            if lat:
+                lines.append(f"    update_to_incorporation "
+                             f"p50 {lat['p50_s']:.4f} s  "
+                             f"p99 {lat['p99_s']:.4f} s")
+            if s.get("slo_burn") is not None:
+                lines.append(f"    slo_burn: {s['slo_burn']:.3f}")
     if agg.get("counters"):
         lines.append("counters:")
         for k, v in sorted(agg["counters"].items()):
@@ -487,11 +575,51 @@ def render_prometheus(agg: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
-def render_report(path: str, fmt: str = "text") -> Tuple[str, str]:
-    """CLI entry: returns (rendered report in ``fmt``, Prometheus text).
-    Both derive from one aggregation pass over the log."""
-    events, bad = load_events(path)
+def _source_view(path: str, events: List[dict], bad: int) -> dict:
+    """The per-source admission/SLO slice of one log — what the merged
+    report shows next to the combined numbers."""
     agg = aggregate(events, malformed=bad)
+    summ = ((agg.get("serving") or {}).get("summary")
+            or (agg.get("serving") or {}).get("last_tick") or {})
+    signals = summ.get("signals") or {}
+    return {"path": path, "events": len(events),
+            "admission": summ.get("admission"),
+            "update_to_incorporation": summ.get("update_to_incorporation"),
+            "slo_burn": signals.get("slo_burn")}
+
+
+def render_report(path, fmt: str = "text",
+                  heartbeat: Optional[str] = None,
+                  process_count: int = 0) -> Tuple[str, str]:
+    """CLI entry: returns (rendered report in ``fmt``, Prometheus text).
+    Both derive from one aggregation pass over the log.
+
+    ``path`` may be one JSONL path or a list of them — multiple sinks
+    (a serve log + a gang log + a controller log) merge into one
+    combined aggregation plus a per-source admission/SLO view.
+    ``heartbeat`` + ``process_count`` add live supervisor heartbeat
+    status rows (serving/parked/stale/missing) to the resilience
+    section.
+    """
+    paths = [path] if isinstance(path, str) else list(path)
+    per_source = []
+    events: List[dict] = []
+    bad = 0
+    for p in paths:
+        ev, b = load_events(p)
+        per_source.append((p, ev, b))
+        events.extend(ev)
+        bad += b
+    agg = aggregate(events, malformed=bad)
+    if len(paths) > 1:
+        agg["sources"] = [_source_view(p, ev, b)
+                          for p, ev, b in per_source]
+    if heartbeat:
+        from fedtpu.autoscale.signals import read_gang_members
+        agg["heartbeats"] = [
+            {"process": idx, "status": status}
+            for idx, status in read_gang_members(
+                heartbeat, max(1, process_count))]
     if fmt == "json":
         rendered = json.dumps(agg, indent=2, sort_keys=True)
     else:

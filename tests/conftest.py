@@ -205,6 +205,16 @@ QUICK_TESTS = {
     "test_reshard.py::test_row_maps",
     "test_reshard.py::test_spool_roundtrip_and_generation_fence",
     "test_reshard.py::test_signal_agreement_converges",
+    # round-10 modules
+    # autoscale control plane (policy/bus/simulator are backend-free,
+    # seconds; the engine integration, report merge, and chaos drill
+    # stay full-tier)
+    "test_autoscale.py::test_simulate_decision_sequence_is_bitwise"
+    "_deterministic",
+    "test_autoscale.py::test_threshold_policy_requires_consecutive"
+    "_hot_ticks",
+    "test_autoscale.py::test_signal_bus_folds_stats_and_prefers"
+    "_exported_burn",
 }
 
 

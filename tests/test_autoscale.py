@@ -1,0 +1,414 @@
+"""fedtpu.autoscale — the SLO-driven control plane (ISSUE 11 tier-1
+suite).
+
+Pins the contracts the autoscale subsystem documents:
+- the virtual-time simulator is bitwise-deterministic and its decision
+  sequence matches the COMMITTED golden through the CLI gate (the
+  acceptance criterion — `fedtpu autoscale --simulate --golden`);
+- the default threshold policy honors hysteresis (N consecutive hot
+  snapshots before acting) and cooldown (a refractory window after
+  every action), and a preemption NOTICE bypasses both with the
+  pre_drain ordered BEFORE the shrink;
+- the SignalBus fold: version stamping, SLO-burn math off the
+  cumulative le-bucket histogram, and preferring a stats payload's own
+  exported burn over recomputation;
+- the serving engine's machine-readable signals block, runtime
+  configure, and the pre-drain durability spool;
+- `fedtpu report` over multiple sinks: combined + per-source view,
+  the autoscale section, and heartbeat status rows.
+
+The full control-plane drill (serve + gang + live controller under a
+real preemption notice) is the slow-marked chaos row at the bottom.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fedtpu.autoscale.controller import (compare_decisions, simulate,
+                                         write_decisions)
+from fedtpu.autoscale.policy import (HOLD, PRE_DRAIN, SHRINK, Decision,
+                                     ThresholdHysteresisPolicy, get_policy,
+                                     register_policy)
+from fedtpu.autoscale.signals import (SignalBus, Snapshot,
+                                      read_gang_members, slo_burn_from_hist)
+from fedtpu.cli import main as cli_main
+from fedtpu.config import AutoscaleConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "goldens", "autoscale_sim.jsonl")
+
+
+def _snap(version=0, t=0.0, **kw):
+    return Snapshot(version=version, t=t, **kw)
+
+
+# ------------------------------------------------------------- simulator
+
+def test_simulate_decision_sequence_is_bitwise_deterministic():
+    """Two fresh simulations of the seeded trace produce byte-identical
+    decision JSONL — the property the committed golden rests on — and
+    the run exercises the interesting paths: the backlog drains fully
+    (not truncated by the safety valve) and the mid-burst preemption
+    notice spools real pending work."""
+    a, b = simulate(), simulate()
+    assert a["lines"] == b["lines"]
+    assert len(a["lines"]) >= 10
+    s = a["summary"]
+    assert s["control_ticks"] == len(a["lines"])
+    assert not s["truncated"]
+    assert s["spooled"] > 0                  # the notice hit a real backlog
+    assert s["decisions"].get("pre_drain") == 1
+    assert s["incorporated"] == s["admitted"]
+    assert s["backlog_end"] == 0
+
+
+def test_autoscale_cli_matches_committed_golden(capsys):
+    """The tier-1 gate: the CLI simulation replays bitwise against the
+    committed golden and says so (audit-gate idiom)."""
+    rc = cli_main(["autoscale", "--simulate", "--golden", GOLDEN])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert f"golden: matches {GOLDEN}" in out
+
+
+def test_autoscale_cli_fails_on_divergent_golden(tmp_path, capsys):
+    """A tampered golden must FAIL the gate with the first divergence
+    named — silent pass on mismatch would make the contract decorative."""
+    lines = simulate()["lines"]
+    rec = json.loads(lines[0])
+    rec["t"] += 1.0
+    bad = [json.dumps(rec, sort_keys=True, separators=(",", ":"))]
+    bad += lines[1:]
+    path = str(tmp_path / "bad.jsonl")
+    write_decisions(path, bad)
+    rc = cli_main(["autoscale", "--simulate", "--golden", path])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "first divergence at line 1" in out
+
+
+def test_golden_is_clean_decision_contract():
+    """The committed artifact itself: every line parses, is in canonical
+    form (sorted keys, no whitespace — byte comparison IS the check),
+    carries schema v1, and the sequence contains exactly one pre_drain
+    ordered immediately before a shrink."""
+    with open(GOLDEN, encoding="utf-8") as fh:
+        raw = [ln.rstrip("\n") for ln in fh if ln.strip()]
+    assert len(raw) >= 10
+    kinds_per_line = []
+    for i, line in enumerate(raw):
+        rec = json.loads(line)
+        assert line == json.dumps(rec, sort_keys=True,
+                                  separators=(",", ":"))
+        assert rec["v"] == 1
+        assert rec["version"] == i          # gapless snapshot versions
+        kinds_per_line.append([d["kind"] for d in rec["decisions"]])
+    pre = [ks for ks in kinds_per_line if PRE_DRAIN in ks]
+    assert len(pre) == 1
+    assert pre[0].index(PRE_DRAIN) < pre[0].index(SHRINK)
+
+
+def test_compare_decisions_reports_count_and_divergence(tmp_path):
+    path = str(tmp_path / "g.jsonl")
+    write_decisions(path, ["a", "b", "c"])
+    assert compare_decisions(["a", "b", "c"], path)["ok"]
+    short = compare_decisions(["a", "b"], path)
+    assert not short["ok"] and "count 2 != golden 3" in short["reason"]
+    div = compare_decisions(["a", "X", "c"], path)
+    assert not div["ok"] and "line 2" in div["reason"]
+    gone = compare_decisions(["a"], str(tmp_path / "missing.jsonl"))
+    assert not gone["ok"] and "unreadable" in gone["reason"]
+
+
+# ---------------------------------------------------------------- policy
+
+def _hot(version, t):
+    return _snap(version, t, backlog=10_000)     # >> backlog_high
+
+
+def _cold(version, t):
+    return _snap(version, t, backlog=0)
+
+
+def test_threshold_policy_requires_consecutive_hot_ticks():
+    """hysteresis_ticks=3: two hot snapshots hold; the third scales up
+    with the full action triple; one cold snapshot in between resets
+    the streak."""
+    cfg = AutoscaleConfig(hysteresis_ticks=3, cooldown_ticks=0)
+    pol = ThresholdHysteresisPolicy(cfg)
+    st = pol.initial_state()
+    d1, st = pol.decide(_hot(0, 0.5), st)
+    d2, st = pol.decide(_hot(1, 1.0), st)
+    assert [d.kind for d in d1] == [HOLD] and [d.kind for d in d2] == [HOLD]
+    # A cold tick resets the hot streak — two more hots still hold.
+    _, st = pol.decide(_cold(2, 1.5), st)
+    d4, st = pol.decide(_hot(3, 2.0), st)
+    d5, st = pol.decide(_hot(4, 2.5), st)
+    assert [d.kind for d in d4] == [HOLD] and [d.kind for d in d5] == [HOLD]
+    d6, st = pol.decide(_hot(5, 3.0), st)
+    assert [d.kind for d in d6] == ["grow", "set_tick_cadence",
+                                    "set_cohort_size"]
+    assert d6[1].value == cfg.tick_fast_s
+    assert d6[2].value == float(cfg.cohort_high)
+
+
+def test_threshold_policy_cooldown_is_refractory():
+    """Every action opens cooldown_ticks of forced holds: a still-hot
+    system cannot re-trigger until the actuated change has had a chance
+    to land."""
+    cfg = AutoscaleConfig(hysteresis_ticks=1, cooldown_ticks=2)
+    pol = ThresholdHysteresisPolicy(cfg)
+    st = pol.initial_state()
+    d, st = pol.decide(_hot(0, 0.5), st)
+    assert d[0].kind == "grow"
+    for v in (1, 2):
+        d, st = pol.decide(_hot(v, 0.5 + 0.5 * v), st)
+        assert [x.kind for x in d] == [HOLD]
+    d, st = pol.decide(_hot(3, 2.0), st)
+    assert d[0].kind == "grow"               # cooldown elapsed, acts again
+
+
+def test_preemption_notice_bypasses_hysteresis():
+    """A notice on the very first snapshot — zero hot history, backlog
+    quiet — still acts immediately: pre_drain(victim) strictly before
+    shrink, then the cooldown applies so the next tick holds."""
+    cfg = AutoscaleConfig(hysteresis_ticks=5, cooldown_ticks=3)
+    pol = ThresholdHysteresisPolicy(cfg)
+    d, st = pol.decide(_snap(0, 0.5, notice=1), pol.initial_state())
+    assert [x.kind for x in d] == [PRE_DRAIN, SHRINK]
+    assert d[0].victim == 1
+    d2, st = pol.decide(_hot(1, 1.0), st)
+    assert [x.kind for x in d2] == [HOLD]
+
+
+def test_policy_registry_rejects_duplicates_and_unknown_names():
+    assert isinstance(get_policy("threshold", AutoscaleConfig()),
+                      ThresholdHysteresisPolicy)
+    with pytest.raises(ValueError, match="already registered"):
+        register_policy("threshold", ThresholdHysteresisPolicy)
+    with pytest.raises(ValueError, match="unknown policy"):
+        get_policy("nope", AutoscaleConfig())
+
+
+def test_decision_shape_is_closed():
+    with pytest.raises(ValueError, match="unknown decision kind"):
+        Decision("explode")
+    # Fixed serialized shape: no optional keys for the bitwise golden.
+    assert set(Decision(HOLD).to_json()) == {"kind", "n", "value", "victim"}
+
+
+# --------------------------------------------------------------- signals
+
+def test_slo_burn_from_hist_math():
+    # 4 observations against bins (..., 1.0, ...): 1 above the 1.0
+    # bound. Violating share 0.25 over budget 0.1 => burn 2.5.
+    hist = {"count": 4, "bins": [0.5, 1.0, 5.0],
+            "bucket_counts": [1, 3, 4]}
+    assert slo_burn_from_hist(hist, 1.0, 0.1) == pytest.approx(2.5)
+    # Objective beyond the last bound: everything passes.
+    assert slo_burn_from_hist(hist, 10.0, 0.1) == 0.0
+    # Missing / empty histograms are quiet zeros, not crashes.
+    assert slo_burn_from_hist(None, 1.0, 0.1) == 0.0
+    assert slo_burn_from_hist({"count": 0}, 1.0, 0.1) == 0.0
+    with pytest.raises(ValueError, match="error_budget"):
+        slo_burn_from_hist(hist, 1.0, 0.0)
+
+
+def test_signal_bus_folds_stats_and_prefers_exported_burn():
+    bus = SignalBus(objective_s=1.0, error_budget=0.1)
+    hist = {"count": 4, "bins": [1.0], "bucket_counts": [3]}
+    # The stats payload's own slo_burn (the serving engine's export)
+    # wins over histogram recomputation — live and sim read one number.
+    s1 = bus.fold(1.0, stats={"backlog": 7, "slo_burn": 0.125},
+                  latency_hist=hist)
+    assert s1.version == 0 and s1.backlog == 7 and s1.slo_burn == 0.125
+    # No exported burn: fall back to the histogram fold.
+    s2 = bus.fold(2.0, stats={"backlog": 1},
+                  members=[(0, "serving"), (1, "parked")], notice=1,
+                  latency_hist=hist)
+    assert s2.version == 1                      # auto-increments
+    assert s2.slo_burn == pytest.approx(2.5)
+    assert s2.members == ((0, "serving"), (1, "parked"))
+    assert s2.notice == 1
+    # Snapshots serialize with the full fixed shape.
+    assert s2.to_json()["v"] == 1
+    with pytest.raises(ValueError):
+        SignalBus(objective_s=0.0)
+
+
+def test_read_gang_members_statuses(tmp_path):
+    from fedtpu.resilience.distributed import heartbeat_path_for
+    from fedtpu.resilience.supervisor import write_heartbeat
+
+    base = str(tmp_path / "hb")
+    write_heartbeat(heartbeat_path_for(base, 0), status="serving")
+    write_heartbeat(heartbeat_path_for(base, 1), status="parked")
+    now = time.time()
+    members = read_gang_members(base, 4, now=now)
+    assert members == ((0, "serving"), (1, "parked"), (2, "missing"),
+                       (3, "missing"))
+    # An old beat downgrades to stale — except parked, which is the
+    # supervisor's deliberate steady state, not a liveness failure.
+    members = read_gang_members(base, 2, now=now + 1000.0)
+    assert members == ((0, "stale"), (1, "parked"))
+
+
+def test_admission_window_rates_slide_and_evict():
+    from fedtpu.serving.admission import (ACCEPT, REJECT_BACKPRESSURE,
+                                          AdmissionController,
+                                          AdmissionPolicy)
+    ctl = AdmissionController(AdmissionPolicy(max_pending=1, window_s=2.0))
+    assert ctl.decide(0.0, 0, 0) == ACCEPT
+    assert ctl.decide(0.5, 0, 5) == REJECT_BACKPRESSURE
+    win = ctl.window_rates(1.0)
+    assert win["decisions"] == 2
+    assert win["rates"][ACCEPT] == 0.5
+    assert win["rates"][REJECT_BACKPRESSURE] == 0.5
+    # The accept at t=0 slides out of the 2 s window; cumulative counts
+    # are untouched (one bookkeeping path, two views).
+    win = ctl.window_rates(2.5)
+    assert win["decisions"] == 1
+    assert win["rates"][REJECT_BACKPRESSURE] == 1.0
+    assert ctl.counts[ACCEPT] == 1
+    # Empty window: all-zero shares, no division crash.
+    assert ctl.window_rates(100.0)["rates"][ACCEPT] == 0.0
+
+
+# ---------------------------------------------------- engine integration
+
+def test_engine_signals_configure_and_pre_drain(tmp_path):
+    """The serving side of the control loop, against a real engine:
+    signals() exposes the machine-readable block off the engine's own
+    bookkeeping, configure() retargets cadence/cohort mid-run, and
+    pre_drain() spools the pending queue WITHOUT consuming it."""
+    from fedtpu.config import ServingConfig
+    from fedtpu.serving.engine import ServingEngine
+    from fedtpu.telemetry.metrics import MetricsRegistry
+
+    eng = ServingEngine(ServingConfig(cohort=8, buffer_size=2,
+                                      tick_interval_s=100.0, data_rows=64,
+                                      model_hidden=(8,), seed=0),
+                        registry=MetricsRegistry())
+    for u in range(3):
+        eng.offer(0.1 * (u + 1), u, 0.0)
+    sig = eng.signals()
+    assert sig["backlog"] == 3 and sig["admitted"] == 3
+    assert sig["window_decisions"] == 3
+    assert sig["rates"]["accept"] == 1.0
+    assert sig["slo_burn"] == 0.0            # nothing incorporated yet
+    assert eng.summary()["signals"]["backlog"] == 3   # same block, no fork
+    applied = eng.configure(tick_interval_s=0.25, flush_every=64)
+    assert applied == {"tick_interval_s": 0.25, "flush_every": 64}
+    assert eng.signals()["tick_interval_s"] == 0.25
+    spool = str(tmp_path / "spool.jsonl")
+    n, path = eng.pre_drain(spool)
+    assert (n, path) == (3, spool)
+    assert len(eng.pending) == 3             # durability copy, not a drain
+    with open(spool, encoding="utf-8") as fh:
+        rows = [json.loads(ln) for ln in fh]
+    assert [r["user"] for r in rows] == [0, 1, 2]
+    # No spool_dir configured and no explicit path -> a loud error.
+    with pytest.raises(ValueError, match="spool_dir"):
+        eng.pre_drain()
+
+
+# ---------------------------------------------------------------- report
+
+def test_report_merges_sources_with_autoscale_and_heartbeats(tmp_path):
+    """`fedtpu report a.jsonl b.jsonl --heartbeat hb --num-processes 2`:
+    one combined aggregation (the autoscale section from the controller
+    sink) plus the per-source view and live heartbeat rows."""
+    from fedtpu.resilience.distributed import heartbeat_path_for
+    from fedtpu.resilience.supervisor import write_heartbeat
+    from fedtpu.telemetry import make_tracer
+    from fedtpu.telemetry.report import render_report
+
+    ctl_log = str(tmp_path / "ctl.jsonl")
+    tracer = make_tracer(ctl_log)
+    summary = simulate(tracer=tracer)["summary"]
+    tracer.close()
+    other_log = str(tmp_path / "serve.jsonl")
+    with open(other_log, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({"v": 1, "run_id": "x", "kind": "serve_start",
+                             "phase": None, "round": None, "t_start": 0.0,
+                             "dur_s": 0.0, "payload": {"port": 1}}) + "\n")
+    hb = str(tmp_path / "hb")
+    write_heartbeat(heartbeat_path_for(hb, 0), status="serving")
+    write_heartbeat(heartbeat_path_for(hb, 1), status="parked")
+
+    text, prom = render_report([ctl_log, other_log], heartbeat=hb,
+                               process_count=2)
+    assert f"control ticks: {summary['control_ticks']}" in text
+    assert "pre_drain" in text
+    assert "per-source view" in text
+    assert ctl_log in text and other_log in text
+    assert "heartbeat p0: serving" in text
+    assert "heartbeat p1: parked" in text
+    # Single-path str still works (the long-standing call shape).
+    text_one, _ = render_report(ctl_log)
+    assert "per-source view" not in text_one
+    assert "autoscale" in text_one
+
+
+# ------------------------------------------------------- chaos drill (slow)
+
+@pytest.mark.slow
+def test_chaos_autoscale_absorbs_preemption_without_restart(tmp_path):
+    """The acceptance drill (`mp_autoscale_preempt`): serve under driven
+    load + a 2-process gang; a preemption notice is absorbed by the
+    CONTROLLER's pre-drain spool + live SIGUSR1 shrink — zero gang
+    restarts, no lost admitted updates after the final drain, SLO burn
+    within the pinned budget."""
+    from fedtpu.resilience.chaos import (AUTOSCALE_BURN_BUDGET,
+                                         AUTOSCALE_SCENARIO, run_scenario)
+    from fedtpu.telemetry.report import aggregate, load_events
+
+    wd = str(tmp_path)
+    row = run_scenario(AUTOSCALE_SCENARIO, wd, {}, rounds=6, num_clients=4,
+                       platform="cpu", timeout=600)
+    assert row["ok"], row
+    assert row["gang_restarts"] == 0
+    assert row["reshards"] >= 1 and row["reshard_failures"] == 0
+    assert row["spooled"] > 0
+    assert row["lost_updates"] == 0 and row["backlog"] == 0
+    assert row["acted"].get("pre_drain", 0) >= 1
+    assert row["acted"].get("shrink", 0) >= 1
+    assert row["slo_burn"] is not None
+    assert row["slo_burn"] <= AUTOSCALE_BURN_BUDGET
+    # The controller's decisions came back out of its events sink.
+    events, bad = load_events(
+        os.path.join(wd, f"{AUTOSCALE_SCENARIO}.ctl.events.jsonl"))
+    agg = aggregate(events, malformed=bad)["autoscale"]
+    assert agg["acted"].get("pre_drain", 0) >= 1
+    assert agg["pre_drains"] and agg["pre_drains"][0]["spooled"] > 0
+
+
+@pytest.mark.slow
+def test_check_autoscale_sim_folds_golden_into_exit_code(tmp_path):
+    """`fedtpu check --autoscale-sim` (satellite 6): the pinned golden
+    folds into the one-shot health verdict; a divergent golden fails it
+    in an otherwise healthy environment. Subprocess: check pins the
+    platform at import time."""
+    out = subprocess.run(
+        [sys.executable, "-m", "fedtpu.cli", "check", "--json",
+         "--autoscale-sim", GOLDEN],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    rep = json.loads(out.stdout)
+    assert rep["autoscale_sim"]["ok"] is True
+    bad = str(tmp_path / "bad.jsonl")
+    write_decisions(bad, ["{}"])
+    out = subprocess.run(
+        [sys.executable, "-m", "fedtpu.cli", "check", "--json",
+         "--autoscale-sim", bad],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode != 0
+    rep = json.loads(out.stdout)
+    assert rep["autoscale_sim"]["ok"] is False

@@ -218,7 +218,8 @@ def test_every_documented_flag_exists_in_the_parser():
     for rel in ("README.md", "docs/API.md", "docs/ARCHITECTURE.md",
                 "docs/observability.md", "docs/analysis.md",
                 "docs/performance.md", "docs/resilience.md",
-                "docs/serving.md", "docs/scaling.md", "PARITY.md",
+                "docs/serving.md", "docs/scaling.md", "docs/autoscale.md",
+                "PARITY.md",
                 "benchmarks/RESULTS.md"):
         text = open(os.path.join(root, rel)).read()
         # Underscores ARE captured so `--dp_clip_norm`-style typos show up
