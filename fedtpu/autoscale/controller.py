@@ -260,9 +260,14 @@ class LiveController:
         self.acted: Dict[str, int] = {}
 
     def _connection(self):
+        # The retrying client, not a raw Connection: a control tick that
+        # lands while the serving process restarts (the exact moment a
+        # controller exists for) reconnects with backoff instead of
+        # killing the control loop.
         if self._conn is None:
-            from fedtpu.serving.protocol import Connection
-            self._conn = Connection(self.host, self.port)
+            from fedtpu.serving.client import GatewayClient
+            self._conn = GatewayClient(host=self.host, port=self.port,
+                                       retries=4, backoff_s=0.1)
             self._conn.hello()
         return self._conn
 

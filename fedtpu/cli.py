@@ -387,6 +387,94 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
                             fed=fed, run=run)
 
 
+def _add_serving_flags(p: argparse.ArgumentParser) -> None:
+    """The shared serve/gateway flag surface: a gateway is a serve process
+    plus fleet routing, so every ServingConfig knob means the same thing
+    on both subcommands."""
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (default 127.0.0.1; the "
+                        "protocol is a same-host ingestion socket)")
+    p.add_argument("--port", type=_nonnegative_int, default=0,
+                   help="TCP port (default 0 = ephemeral; pair "
+                        "with --port-file)")
+    p.add_argument("--port-file", default=None, metavar="FILE",
+                   help="write the bound port here once listening "
+                        "(ephemeral-port discovery for loadgen)")
+    p.add_argument("--cohort", type=_positive_int, default=8,
+                   help="concurrent engine slots C; users get "
+                        "stable slot bindings with LRU eviction "
+                        "(default 8)")
+    p.add_argument("--buffer-size", type=_nonnegative_int, default=0,
+                   help="FedBuff K-buffer M: the global only moves "
+                        "once M updates buffered (<=1 applies every "
+                        "tick; default 0)")
+    p.add_argument("--staleness-power", type=_nonnegative_float,
+                   default=0.5,
+                   help="delta discount (1+s)^-p (default 0.5)")
+    p.add_argument("--tick-interval", type=_nonnegative_float,
+                   default=0.5, metavar="S",
+                   help="virtual seconds between engine ticks "
+                        "(0 disables the timer; default 0.5)")
+    p.add_argument("--flush-every", type=_nonnegative_int, default=0,
+                   help="also fire a tick once this many eligible "
+                        "updates pend (0 = timer only)")
+    p.add_argument("--history-window", type=_nonnegative_int,
+                   default=0, metavar="N",
+                   help="keep only the newest N per-tick history "
+                        "rows (0 = unbounded, the determinism "
+                        "artifact; set for long-running servers)")
+    p.add_argument("--rate-limit", type=_nonnegative_float,
+                   default=0.0,
+                   help="token-bucket admission rate in updates per "
+                        "virtual second (0 = off)")
+    p.add_argument("--rate-burst", type=_positive_float, default=64.0,
+                   help="token-bucket burst capacity (default 64)")
+    p.add_argument("--max-pending", type=_nonnegative_int, default=0,
+                   help="reject_backpressure once this many admitted "
+                        "updates await incorporation (0 = off)")
+    p.add_argument("--stale-deprioritize", type=_nonnegative_int,
+                   default=4,
+                   help="versions behind at which an update is "
+                        "deprioritized (default 4)")
+    p.add_argument("--stale-reject", type=_nonnegative_int,
+                   default=16,
+                   help="versions behind at which an update is "
+                        "rejected (default 16)")
+    p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="drain-time (and periodic) serving "
+                        "checkpoints land here; required for "
+                        "--resume")
+    p.add_argument("--checkpoint-every-ticks", type=_nonnegative_int,
+                   default=0,
+                   help="also checkpoint every N engine ticks "
+                        "(0 = drain-time only)")
+    p.add_argument("--resume", action="store_true",
+                   help="restore serving state (engine + pending "
+                        "queue + history) from --checkpoint-dir")
+    p.add_argument("--history", default=None, metavar="JSONL",
+                   help="write the per-tick metric history here at "
+                        "drain — the bitwise-determinism artifact")
+    p.add_argument("--events", default=None, metavar="JSONL",
+                   help="telemetry events sink (read back by "
+                        "'fedtpu report')")
+    p.add_argument("--heartbeat", default=None, metavar="FILE",
+                   help="liveness heartbeat file for 'fedtpu "
+                        "supervise' hang detection")
+    p.add_argument("--once", action="store_true",
+                   help="exit cleanly (drain + checkpoint) after "
+                        "the first client connection closes — "
+                        "bounded smoke runs")
+    p.add_argument("--seed", type=_nonnegative_int, default=0,
+                   help="engine init / synthetic-shard seed")
+    p.add_argument("--platform", choices=["default", "cpu"],
+                   default="default",
+                   help="force the JAX platform before backend init")
+    p.add_argument("--json", action="store_true",
+                   help="print the drain summary as one JSON line")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress server status lines")
+
+
 def build_parser() -> argparse.ArgumentParser:
     """The complete argument parser, exposed separately from ``main`` so
     tests can introspect the real flag surface (e.g. the docs-accuracy
@@ -677,6 +765,14 @@ def build_parser() -> argparse.ArgumentParser:
                               "simulation and compare its decision "
                               "sequence bitwise against this golden "
                               "JSONL, folded into the exit code")
+    check_p.add_argument("--gateway-probe", default=None,
+                         metavar="PORT_FILE_BASE",
+                         help="also probe a live gateway fleet's health "
+                              "over its port-file base (each member "
+                              "answers a stats round-trip), folded into "
+                              "the exit code")
+    check_p.add_argument("--gateway-count", type=_positive_int, default=1,
+                         help="fleet size for --gateway-probe (default 1)")
 
     # IR-level program audit: trace the real engines, extract and verify
     # the collective schedule, prove donation, account comm bytes
@@ -808,9 +904,10 @@ def build_parser() -> argparse.ArgumentParser:
                          help="comma-separated subset of: sigkill, "
                               "preempt, nan_rollback, dropout, straggler, "
                               "mp_kill_worker, mp_kill_coordinator, "
-                              "mp_hang, mp_preempt, mp_autoscale_preempt "
+                              "mp_hang, mp_preempt, mp_autoscale_preempt, "
+                              "mp_gateway_kill, mp_store_shard_kill "
                               "(default: all; the mp_* rows run a "
-                              "2-process gang)")
+                              "2-process gang or gateway fleet)")
     chaos_p.add_argument("--rounds", type=_positive_int, default=10,
                          help="rounds per scenario run (default 10)")
     chaos_p.add_argument("--num-clients", type=_positive_int, default=4,
@@ -843,88 +940,40 @@ def build_parser() -> argparse.ArgumentParser:
                                   "localhost socket, admission-control "
                                   "them, and drive async FedBuff ticks "
                                   "(docs/serving.md)")
-    serve_p.add_argument("--host", default="127.0.0.1",
-                         help="bind address (default 127.0.0.1; the "
-                              "protocol is a same-host ingestion socket)")
-    serve_p.add_argument("--port", type=_nonnegative_int, default=0,
-                         help="TCP port (default 0 = ephemeral; pair "
-                              "with --port-file)")
-    serve_p.add_argument("--port-file", default=None, metavar="FILE",
-                         help="write the bound port here once listening "
-                              "(ephemeral-port discovery for loadgen)")
-    serve_p.add_argument("--cohort", type=_positive_int, default=8,
-                         help="concurrent engine slots C; users get "
-                              "stable slot bindings with LRU eviction "
-                              "(default 8)")
-    serve_p.add_argument("--buffer-size", type=_nonnegative_int, default=0,
-                         help="FedBuff K-buffer M: the global only moves "
-                              "once M updates buffered (<=1 applies every "
-                              "tick; default 0)")
-    serve_p.add_argument("--staleness-power", type=_nonnegative_float,
-                         default=0.5,
-                         help="delta discount (1+s)^-p (default 0.5)")
-    serve_p.add_argument("--tick-interval", type=_nonnegative_float,
-                         default=0.5, metavar="S",
-                         help="virtual seconds between engine ticks "
-                              "(0 disables the timer; default 0.5)")
-    serve_p.add_argument("--flush-every", type=_nonnegative_int, default=0,
-                         help="also fire a tick once this many eligible "
-                              "updates pend (0 = timer only)")
-    serve_p.add_argument("--history-window", type=_nonnegative_int,
-                         default=0, metavar="N",
-                         help="keep only the newest N per-tick history "
-                              "rows (0 = unbounded, the determinism "
-                              "artifact; set for long-running servers)")
-    serve_p.add_argument("--rate-limit", type=_nonnegative_float,
-                         default=0.0,
-                         help="token-bucket admission rate in updates per "
-                              "virtual second (0 = off)")
-    serve_p.add_argument("--rate-burst", type=_positive_float, default=64.0,
-                         help="token-bucket burst capacity (default 64)")
-    serve_p.add_argument("--max-pending", type=_nonnegative_int, default=0,
-                         help="reject_backpressure once this many admitted "
-                              "updates await incorporation (0 = off)")
-    serve_p.add_argument("--stale-deprioritize", type=_nonnegative_int,
-                         default=4,
-                         help="versions behind at which an update is "
-                              "deprioritized (default 4)")
-    serve_p.add_argument("--stale-reject", type=_nonnegative_int,
-                         default=16,
-                         help="versions behind at which an update is "
-                              "rejected (default 16)")
-    serve_p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
-                         help="drain-time (and periodic) serving "
-                              "checkpoints land here; required for "
-                              "--resume")
-    serve_p.add_argument("--checkpoint-every-ticks", type=_nonnegative_int,
-                         default=0,
-                         help="also checkpoint every N engine ticks "
-                              "(0 = drain-time only)")
-    serve_p.add_argument("--resume", action="store_true",
-                         help="restore serving state (engine + pending "
-                              "queue + history) from --checkpoint-dir")
-    serve_p.add_argument("--history", default=None, metavar="JSONL",
-                         help="write the per-tick metric history here at "
-                              "drain — the bitwise-determinism artifact")
-    serve_p.add_argument("--events", default=None, metavar="JSONL",
-                         help="telemetry events sink (read back by "
-                              "'fedtpu report')")
-    serve_p.add_argument("--heartbeat", default=None, metavar="FILE",
-                         help="liveness heartbeat file for 'fedtpu "
-                              "supervise' hang detection")
-    serve_p.add_argument("--once", action="store_true",
-                         help="exit cleanly (drain + checkpoint) after "
-                              "the first client connection closes — "
-                              "bounded smoke runs")
-    serve_p.add_argument("--seed", type=_nonnegative_int, default=0,
-                         help="engine init / synthetic-shard seed")
-    serve_p.add_argument("--platform", choices=["default", "cpu"],
-                         default="default",
-                         help="force the JAX platform before backend init")
-    serve_p.add_argument("--json", action="store_true",
-                         help="print the drain summary as one JSON line")
-    serve_p.add_argument("--quiet", action="store_true",
-                         help="suppress server status lines")
+    _add_serving_flags(serve_p)
+
+    # Gateway fleet: N serve-shaped processes, each owning the id-shard
+    # of clients matching its store shard, with redirect routing and the
+    # flush/adopt shard-failover ops (fedtpu.serving.gateway;
+    # docs/serving.md). Launch N under `fedtpu supervise --num-processes
+    # N -- gateway ...` — every shared path below is a BASE each member
+    # derives its own file/subdir from.
+    gateway_p = sub.add_parser("gateway",
+                               help="one member of a fault-tolerant "
+                                    "multi-gateway ingestion fleet: serve "
+                                    "plus id-shard routing, redirects, "
+                                    "and store-shard failover "
+                                    "(docs/serving.md)")
+    _add_serving_flags(gateway_p)
+    gateway_p.add_argument("--num-gateways", type=_positive_int, default=1,
+                           help="fleet size N; this process owns users "
+                                "with id %% N == its index (default 1)")
+    gateway_p.add_argument("--gateway-index", type=_nonnegative_int,
+                           default=None,
+                           help="this member's index (default: the gang's "
+                                "FEDTPU_PROCESS_ID, so a supervised fleet "
+                                "needs no per-member flags)")
+    gateway_p.add_argument("--total-users", type=_nonnegative_int,
+                           default=0,
+                           help="attach a per-user state store over this "
+                                "population, sharded to the fleet "
+                                "(0 = no store; required for adopt)")
+    gateway_p.add_argument("--store", choices=["memory", "mmap"],
+                           default="memory",
+                           help="store backend (default memory)")
+    gateway_p.add_argument("--store-path", default=None, metavar="FILE",
+                           help="mmap backing file base path (each member "
+                                "appends .g<i>)")
 
     # Load generation: replay (or synthesize) an arrival trace against a
     # running server. jax-free — it can run from any machine beside the
@@ -958,6 +1007,18 @@ def build_parser() -> argparse.ArgumentParser:
                              "--port-file) for the port")
     load_p.add_argument("--batch", type=_positive_int, default=1024,
                         help="arrivals per protocol frame (default 1024)")
+    load_p.add_argument("--num-gateways", type=_positive_int, default=1,
+                        help="route through a gateway fleet of this size: "
+                             "events partition by user id %% N, wrong-"
+                             "gateway redirects are followed (default 1)")
+    load_p.add_argument("--retries", type=_nonnegative_int, default=8,
+                        help="per-frame retry attempts against a dying/"
+                             "restarting gateway before giving up "
+                             "(default 8)")
+    load_p.add_argument("--retry-backoff", type=_positive_float,
+                        default=0.05,
+                        help="base of the capped exponential retry "
+                             "backoff in seconds (default 0.05)")
     load_p.add_argument("--max-events", type=_nonnegative_int, default=0,
                         help="truncate the replay after this many events "
                              "(0 = whole trace)")
@@ -1183,7 +1244,10 @@ def main(argv=None) -> int:
                               port_file=args.port_file, batch=args.batch,
                               max_events=args.max_events,
                               drain=not args.no_drain,
-                              timeout=args.timeout)
+                              timeout=args.timeout,
+                              num_gateways=args.num_gateways,
+                              retries=args.retries,
+                              backoff_s=args.retry_backoff)
         if args.json:
             print(json.dumps(summary, default=float))
         elif not args.quiet:
@@ -1191,6 +1255,11 @@ def main(argv=None) -> int:
                   f"{summary['frames']} frames "
                   f"({summary['events_per_sec']:.0f} ev/s); "
                   f"admission: {summary['admission']}")
+            if summary.get("retried") or summary.get("redirected"):
+                print(f"delivery: attempted {summary['attempted']}, "
+                      f"retried {summary['retried']}, redirected "
+                      f"{summary['redirected']}, reconnects "
+                      f"{summary['reconnects']}")
         return 0
 
     if args.cmd == "autoscale":
@@ -1297,8 +1366,12 @@ def main(argv=None) -> int:
     # Gang child? supervise_gang sets FEDTPU_COORDINATOR & friends per
     # child; wire into the shared jax.distributed runtime BEFORE any
     # other backend touch (the compilation-cache config below counts).
-    from fedtpu.parallel.multihost import initialize_from_env
-    initialize_from_env()
+    # Gateways are the exception: each fleet member runs its OWN
+    # single-process engine — the gang contract is supervision/restart
+    # only, never one SPMD runtime spanning the fleet.
+    if args.cmd != "gateway":
+        from fedtpu.parallel.multihost import initialize_from_env
+        initialize_from_env()
 
     if getattr(args, "compilation_cache", None):
         # Before any compile: every subcommand's first jit lands in (or is
@@ -1377,6 +1450,13 @@ def main(argv=None) -> int:
                 "golden": args.autoscale_sim,
                 "control_ticks": sim["summary"]["control_ticks"]}
             report["ok"] = report["ok"] and cmp["ok"]
+        if args.gateway_probe:
+            # Fold a live fleet health probe into the check: every member
+            # must answer a stats round-trip on its derived port file.
+            from fedtpu.serving.gateway import probe_fleet
+            rows = probe_fleet(args.gateway_probe, args.gateway_count)
+            report["gateway_probe"] = rows
+            report["ok"] = report["ok"] and all(r["ok"] for r in rows)
         if args.json:
             print(json.dumps(report))
         else:
@@ -1393,6 +1473,11 @@ def main(argv=None) -> int:
             if "autoscale_sim" in report:
                 a = report["autoscale_sim"]
                 print(f"autoscale-sim: ok={a['ok']} ({a['reason']})")
+            if "gateway_probe" in report:
+                for r in report["gateway_probe"]:
+                    state = ("up" if r["ok"]
+                             else r.get("error", "unreachable"))
+                    print(f"gateway {r['gateway']}: {state}")
             print(f"ok: {report['ok']}")
         return 0 if report["ok"] else 1
 
@@ -1460,6 +1545,44 @@ def main(argv=None) -> int:
             # SIGTERM drain completed: serving state (engine + pending
             # queue + history) is checkpointed; the supervisor contract's
             # "restart me" code, same as run.
+            if args.json:
+                print(json.dumps({"preempted": True, "tick": p.round}))
+            return EXIT_PREEMPTED
+        if args.json:
+            print(json.dumps(summary, default=float))
+        return 0
+
+    if args.cmd == "gateway":
+        # Before _apply_overrides: a gateway is a serve process plus fleet
+        # routing — same ServingConfig flag set, never an experiment
+        # preset.
+        from fedtpu.config import ServingConfig
+        from fedtpu.resilience.supervisor import EXIT_PREEMPTED, Preempted
+        from fedtpu.serving.gateway import run_gateway
+        scfg = ServingConfig(
+            host=args.host, port=args.port, cohort=args.cohort,
+            buffer_size=args.buffer_size,
+            staleness_power=args.staleness_power,
+            tick_interval_s=args.tick_interval,
+            flush_every=args.flush_every,
+            history_window=args.history_window,
+            rate_limit=args.rate_limit,
+            rate_burst=args.rate_burst, max_pending=args.max_pending,
+            stale_deprioritize=args.stale_deprioritize,
+            stale_reject=args.stale_reject, seed=args.seed)
+        try:
+            summary = run_gateway(
+                scfg, gateway_index=args.gateway_index,
+                num_gateways=args.num_gateways,
+                port_file=args.port_file, events=args.events,
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every_ticks=args.checkpoint_every_ticks,
+                history_path=args.history, heartbeat=args.heartbeat,
+                total_users=args.total_users,
+                store_backend=args.store, store_path=args.store_path,
+                once=args.once, resume=args.resume,
+                verbose=not args.quiet)
+        except Preempted as p:
             if args.json:
                 print(json.dumps({"preempted": True, "tick": p.round}))
             return EXIT_PREEMPTED

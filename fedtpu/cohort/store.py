@@ -31,17 +31,30 @@ host constructs its shard and only ever reads/writes owned ids; the
 scheduler routes cohort members to their owners (single-host runs use
 the default 1-shard store).
 
+Shard failover (:meth:`ClientStateStore.absorb_shard`): when a peer
+shard dies, a survivor adopts its ids from the dead shard's exported
+``checkpoint_arrays`` — digest-verified and GENERATION-fenced, so a
+stale previous-life export is refused loudly. Absorbed ids live in an
+overlay keyed by id (bounded by the dead shard's touched rows, not its
+population); ``owns``/reads/writes treat them exactly like native ids,
+and the handoff is bitwise (rows land as exported).
+
 Checkpoint/restore is Orbax-compatible two ways: ``save``/``restore``
 write a standalone PyTree item ({ids, records} of touched rows only, so
 checkpoint size is bounded by participation, not population), and
 ``checkpoint_arrays``/``restore_arrays`` expose the same arrays for
 embedding in a run checkpoint's meta item — one atomic orbax commit
 covers engine state AND store, so resume can never see one without the
-other.
+other. Every export is stamped with a sha256 content digest that
+``restore_arrays``/``absorb_shard`` verify — a corrupt mmap restore
+(the ``ckpt_corrupt`` fault kind) fails loudly instead of silently
+reinterpreting bytes, and the ``load_checkpoint_fallback`` walk can
+step past it to an older round.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import List, Optional, Sequence, Tuple
 
@@ -57,6 +70,19 @@ BACKENDS = ("memory", "mmap")
 
 def _pad8(n: int) -> int:
     return (n + 7) // 8 * 8
+
+
+def _content_digest(record_bytes: int, total_clients: int,
+                    shard_index: int, num_shards: int,
+                    ids: np.ndarray, recs: np.ndarray) -> np.ndarray:
+    """sha256 over shard geometry + ids + record bytes, as a (32,)
+    uint8 array (orbax meta items hold numpy, not hex strings)."""
+    h = hashlib.sha256()
+    h.update(np.asarray([record_bytes, total_clients, shard_index,
+                         num_shards], np.int64).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(ids, np.int64)).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(recs, np.uint8)).tobytes())
+    return np.frombuffer(h.digest(), np.uint8).copy()
 
 
 def state_template(state, num_slots: int) -> List[Tuple[tuple, np.dtype]]:
@@ -115,43 +141,97 @@ class ClientStateStore:
                                   mode="w+" if fresh else "r+",
                                   shape=(self.rows, self.record_bytes))
         self._touched: set = set()
+        # Failover overlay: peer shard indices this store has ABSORBED
+        # (absorb_shard) and their rows keyed by client id — the native
+        # array geometry only fits natively-owned ids. Bounded by the
+        # dead shards' touched rows.
+        self._absorbed: set = set()
+        self._overlay: dict = {}
+        # Stamped into checkpoint_arrays when set (the gateway sets its
+        # launch id); absorb_shard fences against it.
+        self.generation: Optional[str] = None
 
     # -- id routing ----------------------------------------------------
     def owns(self, ids) -> np.ndarray:
         ids = np.asarray(ids, np.int64)
-        return (ids % self.num_shards) == self.shard_index
+        shards = ids % self.num_shards
+        mask = shards == self.shard_index
+        for a in self._absorbed:
+            mask = mask | (shards == a)
+        return mask
 
     def _rows_for(self, ids) -> np.ndarray:
+        """Native-array rows for NATIVELY-owned ids (absorbed ids live
+        in the overlay and are rejected here — use _fetch/_store)."""
         ids = np.asarray(ids, np.int64)
         if ids.size and (ids.min() < 0 or ids.max() >= self.total_clients):
             raise ValueError(
                 f"client id out of range [0, {self.total_clients}): "
                 f"{ids[(ids < 0) | (ids >= self.total_clients)][:4]}")
-        if not np.all(self.owns(ids)):
-            bad = ids[~self.owns(ids)][:4]
+        native = (ids % self.num_shards) == self.shard_index
+        if not np.all(native):
+            bad = ids[~native][:4]
             raise ValueError(
                 f"ids {bad} not owned by shard {self.shard_index}/"
                 f"{self.num_shards} — route cohort members to their "
                 f"owning shard")
         return ids // self.num_shards
 
+    def _split(self, ids) -> Tuple[np.ndarray, np.ndarray]:
+        """Validated ``(ids, native_mask)``: every id must be in range
+        and owned (natively or via an absorbed shard)."""
+        ids = np.asarray(ids, np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.total_clients):
+            raise ValueError(
+                f"client id out of range [0, {self.total_clients}): "
+                f"{ids[(ids < 0) | (ids >= self.total_clients)][:4]}")
+        own = self.owns(ids)
+        if not np.all(own):
+            bad = ids[~own][:4]
+            raise ValueError(
+                f"ids {bad} not owned by shard {self.shard_index}/"
+                f"{self.num_shards} — route cohort members to their "
+                f"owning shard")
+        return ids, (ids % self.num_shards) == self.shard_index
+
+    def _fetch(self, ids) -> np.ndarray:
+        """A ``(K, record_bytes)`` uint8 COPY of the records for ``ids``
+        — native rows from the backing array, absorbed rows from the
+        overlay (zero-fill for never-written absorbed ids)."""
+        ids, native = self._split(ids)
+        out = np.zeros((ids.size, self.record_bytes), np.uint8)
+        if native.any():
+            out[native] = self._arr[ids[native] // self.num_shards]
+        for i in np.flatnonzero(~native):
+            rec = self._overlay.get(int(ids[i]))
+            if rec is not None:
+                out[i] = rec
+        return out
+
+    def _store(self, ids, rows: np.ndarray) -> None:
+        ids, native = self._split(ids)
+        if native.any():
+            self._arr[ids[native] // self.num_shards] = rows[native]
+        for i in np.flatnonzero(~native):
+            self._overlay[int(ids[i])] = np.asarray(rows[i],
+                                                    np.uint8).copy()
+        self._touched.update(int(i) for i in ids)
+
     # -- header fields -------------------------------------------------
     def versions(self, ids) -> np.ndarray:
-        rows = self._rows_for(ids)
         raw = np.ascontiguousarray(
-            self._arr[rows, _VER_OFF:_VER_OFF + 8])
+            self._fetch(ids)[:, _VER_OFF:_VER_OFF + 8])
         return raw.view(np.uint64).reshape(-1)
 
     def participation(self, ids) -> np.ndarray:
-        rows = self._rows_for(ids)
         raw = np.ascontiguousarray(
-            self._arr[rows, _PART_OFF:_PART_OFF + 8])
+            self._fetch(ids)[:, _PART_OFF:_PART_OFF + 8])
         return raw.view(np.uint64).reshape(-1)
 
     def read_keys(self, ids) -> np.ndarray:
         """(K, 2) uint32 per-client PRNG key data."""
-        rows = self._rows_for(ids)
-        raw = np.ascontiguousarray(self._arr[rows, _KEY_OFF:_KEY_OFF + 8])
+        raw = np.ascontiguousarray(
+            self._fetch(ids)[:, _KEY_OFF:_KEY_OFF + 8])
         return raw.view(np.uint32).reshape(-1, 2)
 
     # -- records -------------------------------------------------------
@@ -159,13 +239,12 @@ class ClientStateStore:
         """The stored leaves for ``ids``: one ``(K, *shape)`` array per
         template leaf, bitwise as written. Records with version 0 return
         their zero-fill — callers gate on :meth:`versions`."""
-        rows_idx = self._rows_for(ids)
-        rows = np.asarray(self._arr[rows_idx])  # fancy index: a copy
+        rows = self._fetch(ids)
         out = []
         for (shape, dtype), off in zip(self.template, self._offsets):
             nb = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
             flat = np.ascontiguousarray(rows[:, off:off + nb])
-            out.append(flat.view(dtype).reshape((len(rows_idx),) + shape))
+            out.append(flat.view(dtype).reshape((len(rows),) + shape))
         return out
 
     def write(self, ids, leaves: Sequence, keys=None,
@@ -179,8 +258,7 @@ class ClientStateStore:
         if len(leaves) != len(self.template):
             raise ValueError(f"expected {len(self.template)} leaves, got "
                              f"{len(leaves)}")
-        rows_idx = self._rows_for(ids)
-        rows = np.asarray(self._arr[rows_idx])
+        rows = self._fetch(ids)
         k = ids.size
         for (shape, dtype), off, leaf in zip(self.template, self._offsets,
                                              leaves):
@@ -209,8 +287,7 @@ class ClientStateStore:
                 raise ValueError(f"keys must be (K, 2) uint32, got "
                                  f"{kk.shape}")
             rows[:, _KEY_OFF:_KEY_OFF + 8] = kk.view(np.uint8)
-        self._arr[rows_idx] = rows
-        self._touched.update(int(i) for i in ids)
+        self._store(ids, rows)
 
     def flush(self) -> None:
         if self.backend == "mmap":
@@ -241,18 +318,37 @@ class ClientStateStore:
         """Touched rows as plain numpy — suitable for a run checkpoint's
         orbax meta item (zero-length arrays are dropped by
         save_checkpoint when nothing is touched; restore treats missing
-        keys as an empty store)."""
+        keys as an empty store). Stamped with the shard identity, a
+        sha256 content digest (restore_arrays/absorb_shard verify it),
+        any absorbed shard set, and — when :attr:`generation` is set —
+        the generation fence absorb_shard checks."""
         ids = np.array(sorted(self._touched), np.int64)
-        recs = (np.asarray(self._arr[self._rows_for(ids)])
-                if ids.size else np.zeros((0, self.record_bytes), np.uint8))
-        return {"store_ids": ids, "store_records": recs,
-                "store_record_bytes": np.int64(self.record_bytes),
-                "store_total_clients": np.int64(self.total_clients)}
+        recs = (self._fetch(ids) if ids.size
+                else np.zeros((0, self.record_bytes), np.uint8))
+        out = {"store_ids": ids, "store_records": recs,
+               "store_record_bytes": np.int64(self.record_bytes),
+               "store_total_clients": np.int64(self.total_clients),
+               "store_shard_index": np.int64(self.shard_index),
+               "store_num_shards": np.int64(self.num_shards),
+               "store_digest": _content_digest(
+                   self.record_bytes, self.total_clients,
+                   self.shard_index, self.num_shards, ids, recs)}
+        if self._absorbed:
+            out["store_absorbed"] = np.asarray(sorted(self._absorbed),
+                                               np.int64)
+        if self.generation:
+            out["store_generation"] = np.frombuffer(
+                self.generation.encode(), np.uint8).copy()
+        return out
 
     def restore_arrays(self, arrays: dict) -> None:
         """Load rows saved by :meth:`checkpoint_arrays`; validates the
-        record geometry so a changed model/optimizer fails loudly rather
-        than reinterpreting bytes."""
+        record geometry AND the content digest, so a changed
+        model/optimizer or a corrupted restore (a truncated mmap, the
+        ``ckpt_corrupt`` fault) fails loudly rather than reinterpreting
+        bytes. Re-absorbs any shard set the checkpoint recorded before
+        loading rows, so a resumed survivor keeps answering for the ids
+        it adopted."""
         ids = np.asarray(arrays.get("store_ids",
                                     np.zeros((0,), np.int64)), np.int64)
         recs = np.asarray(arrays.get(
@@ -260,14 +356,86 @@ class ClientStateStore:
             np.uint8)
         rb = int(arrays.get("store_record_bytes", self.record_bytes))
         tc = int(arrays.get("store_total_clients", self.total_clients))
+        si = int(arrays.get("store_shard_index", self.shard_index))
+        ns = int(arrays.get("store_num_shards", self.num_shards))
         if rb != self.record_bytes or tc != self.total_clients:
             raise ValueError(
                 f"store checkpoint geometry mismatch: saved "
                 f"record_bytes={rb} total_clients={tc}, store has "
                 f"{self.record_bytes}/{self.total_clients}")
+        if si != self.shard_index or ns != self.num_shards:
+            raise ValueError(
+                f"store checkpoint belongs to shard {si}/{ns}, this "
+                f"store is shard {self.shard_index}/{self.num_shards}")
+        dig = arrays.get("store_digest")
+        if dig is not None:
+            want = _content_digest(rb, tc, si, ns, ids, recs)
+            if not np.array_equal(
+                    np.atleast_1d(np.asarray(dig, np.uint8)), want):
+                raise ValueError(
+                    "store checkpoint digest mismatch — records are "
+                    "corrupt (truncated/overwritten restore); refusing "
+                    "to load them")
+        if arrays.get("store_absorbed") is not None:
+            self._absorbed.update(
+                int(a) for a in np.atleast_1d(arrays["store_absorbed"]))
         if ids.size:
-            self._arr[self._rows_for(ids)] = recs
-            self._touched.update(int(i) for i in ids)
+            self._store(ids, recs)
+
+    def absorb_shard(self, arrays: dict, *,
+                     expected_generation: Optional[str] = None) -> int:
+        """Failover: take ownership of a DEAD peer shard's ids, loading
+        its exported rows (its last touched-row ``checkpoint_arrays``)
+        into the overlay. The export is digest-verified and
+        generation-fenced — pass the generation the dead shard
+        advertised (its flush ack) and a stale previous-life or corrupt
+        export is refused loudly instead of resurrecting old state.
+        Bitwise: rows land exactly as exported (the handoff-roundtrip
+        test pins it). Returns the number of rows absorbed."""
+        rb = int(arrays.get("store_record_bytes", -1))
+        tc = int(arrays.get("store_total_clients", -1))
+        ns = int(arrays.get("store_num_shards", -1))
+        dead = int(arrays.get("store_shard_index", -1))
+        if (rb != self.record_bytes or tc != self.total_clients
+                or ns != self.num_shards):
+            raise ValueError(
+                f"shard export geometry mismatch: record_bytes={rb} "
+                f"total_clients={tc} num_shards={ns}, survivor has "
+                f"{self.record_bytes}/{self.total_clients}/"
+                f"{self.num_shards}")
+        if not 0 <= dead < self.num_shards or dead == self.shard_index:
+            raise ValueError(
+                f"cannot absorb shard {dead} into shard "
+                f"{self.shard_index}/{self.num_shards}")
+        gen = arrays.get("store_generation")
+        gen = (bytes(np.atleast_1d(np.asarray(gen, np.uint8))).decode()
+               if gen is not None else None)
+        if expected_generation is not None and gen != expected_generation:
+            raise ValueError(
+                f"shard export generation {gen!r} does not match the "
+                f"expected {expected_generation!r} — refusing a stale "
+                "handoff")
+        ids = np.asarray(arrays.get("store_ids",
+                                    np.zeros((0,), np.int64)), np.int64)
+        recs = np.asarray(arrays.get(
+            "store_records", np.zeros((0, self.record_bytes), np.uint8)),
+            np.uint8)
+        dig = arrays.get("store_digest")
+        if dig is not None:
+            want = _content_digest(rb, tc, dead, ns, ids, recs)
+            if not np.array_equal(
+                    np.atleast_1d(np.asarray(dig, np.uint8)), want):
+                raise ValueError(
+                    "shard export digest mismatch — records are "
+                    "corrupt; refusing the absorb")
+        if ids.size and not np.all(ids % self.num_shards == dead):
+            raise ValueError(
+                f"shard export contains ids outside shard {dead}")
+        self._absorbed.add(dead)
+        for i, rec in zip(ids, recs):
+            self._overlay[int(i)] = np.asarray(rec, np.uint8).copy()
+        self._touched.update(int(i) for i in ids)
+        return int(ids.size)
 
     def save(self, directory: str) -> None:
         """Standalone Orbax checkpoint of the touched rows."""

@@ -74,12 +74,18 @@ def is_client_leaf(leaf) -> bool:
     return spec is not None and len(spec) > 0 and spec[0] == CLIENTS_AXIS
 
 
-def host_rows(leaf, rows: slice) -> np.ndarray:
+def host_rows(leaf, rows: slice, remote_rows: Optional[Callable] = None,
+              path: str = "") -> np.ndarray:
     """This process's host copy of global client rows [rows.start,
     rows.stop) of a client-sharded leaf, assembled from its OWN addressable
     shards. A requested row held only by another process raises — the
     no-wire invariant that keeps a parked/preempted peer off every
-    reshard critical path."""
+    reshard critical path — UNLESS ``remote_rows`` is given: then
+    non-addressable rows (including rows past the source extent, the
+    absorb-from-a-dead-peer case) are filled by ``remote_rows(path,
+    missing_global_indices, row_shape, dtype)``, the genuinely
+    cross-host row path a shard failover feeds from the dead peer's
+    exported arrays."""
     lo, hi = int(rows.start), int(rows.stop)
     out = np.empty((hi - lo,) + leaf.shape[1:], dtype=leaf.dtype)
     covered = np.zeros((hi - lo,), dtype=bool)
@@ -94,12 +100,21 @@ def host_rows(leaf, rows: slice) -> np.ndarray:
         out[a - lo:b - lo] = data[a - s0:b - s0]
         covered[a - lo:b - lo] = True
     if not covered.all():
-        missing = (np.flatnonzero(~covered) + lo).tolist()
-        raise ValueError(
-            f"host_rows: global client rows {missing} are not addressable "
-            "on this process (no-wire reshard invariant violated — the "
-            "surviving processes must own a contiguous block containing "
-            "every carried row)")
+        missing = np.flatnonzero(~covered) + lo
+        if remote_rows is None:
+            raise ValueError(
+                f"host_rows: global client rows {missing.tolist()} are "
+                "not addressable on this process (no-wire reshard "
+                "invariant violated — the surviving processes must own a "
+                "contiguous block containing every carried row)")
+        fill = np.asarray(remote_rows(path, missing, leaf.shape[1:],
+                                      leaf.dtype), dtype=leaf.dtype)
+        if fill.shape != (missing.size,) + leaf.shape[1:]:
+            raise ValueError(
+                f"remote_rows returned shape {fill.shape} for "
+                f"{missing.size} row(s) of {path!r} "
+                f"(want {(missing.size,) + leaf.shape[1:]})")
+        out[missing - lo] = fill
     return out
 
 
@@ -126,7 +141,9 @@ def grow_row_map(src_clients: int, dst_clients: int,
             for j in range(dst_clients)]
 
 
-def _gather_rows(leaf, rows: np.ndarray) -> np.ndarray:
+def _gather_rows(leaf, rows: np.ndarray,
+                 remote_rows: Optional[Callable] = None,
+                 path: str = "") -> np.ndarray:
     """host_rows over an arbitrary (sorted or not) row list, batching
     contiguous runs so each shard's device->host copy happens once."""
     parts = []
@@ -135,7 +152,8 @@ def _gather_rows(leaf, rows: np.ndarray) -> np.ndarray:
         j = i
         while j + 1 < len(rows) and rows[j + 1] == rows[j] + 1:
             j += 1
-        parts.append(host_rows(leaf, slice(int(rows[i]), int(rows[j]) + 1)))
+        parts.append(host_rows(leaf, slice(int(rows[i]), int(rows[j]) + 1),
+                               remote_rows=remote_rows, path=path))
         i = j + 1
     if not parts:
         return np.empty((0,) + leaf.shape[1:], dtype=leaf.dtype)
@@ -147,6 +165,9 @@ def reshard_state(state, *, dst_mesh, dst_clients: int,
                   join_rows: Optional[Callable[[str, np.ndarray, tuple,
                                                 np.dtype], np.ndarray]] = None,
                   replicated_values: Optional[Dict[str, np.ndarray]] = None,
+                  remote_rows: Optional[Callable[[str, np.ndarray, tuple,
+                                                  np.dtype],
+                                                 np.ndarray]] = None,
                   ) -> Tuple[object, List[ReshardStep]]:
     """Execute the redistribution plan: return (new_state on ``dst_mesh``
     with ``dst_clients`` client rows, executed plan steps).
@@ -154,7 +175,11 @@ def reshard_state(state, *, dst_mesh, dst_clients: int,
     ``row_map[j]`` is the SOURCE row carried into target row j, or -1 for
     a join row. Every process materializes only its dst-local rows; carried
     rows must be locally addressable in ``state`` (host_rows raises
-    otherwise). ``join_rows(path, join_indices, row_shape, dtype)`` supplies
+    otherwise) — unless ``remote_rows(path, missing_global_indices,
+    row_shape, dtype)`` is given, which supplies rows this process cannot
+    see locally (a dead peer's exported arrays during a shard failover;
+    row_map entries past the source extent are legal in that mode).
+    ``join_rows(path, join_indices, row_shape, dtype)`` supplies
     values for this process's join rows (default: zeros — fresh optimizer
     moments / variates). ``replicated_values`` overrides replicated leaves
     by path (a grown-back process must take the CURRENT spooled values, not
@@ -188,7 +213,8 @@ def reshard_state(state, *, dst_mesh, dst_clients: int,
                              dtype=leaf.dtype)
             if carried:
                 vals = _gather_rows(
-                    leaf, np.asarray([src for _, src in carried]))
+                    leaf, np.asarray([src for _, src in carried]),
+                    remote_rows=remote_rows, path=path)
                 local[[pos - sl.start for pos, _ in carried]] = vals
             if joins:
                 jidx = np.asarray(joins)

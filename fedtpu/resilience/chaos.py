@@ -75,6 +75,36 @@ and fires the live shrink (SIGUSR1 through the gang supervisor):
                         bar: signal timing is wall-clock, so the
                         reshard round legitimately varies run to run.
 
+The GATEWAY rows exercise the fault-tolerant ingestion tier
+(fedtpu.serving.gateway; docs/serving.md) — a 2-gateway fleet, each
+member owning the id-shard of clients matching its store shard:
+
+  mp_gateway_kill      SIGKILL gateway 1 mid-load, AFTER it processes a
+                       session-stamped frame but BEFORE the ack leaves
+                       (the lost-ack window). The gang supervisor
+                       restarts the fleet with --resume; the engine's
+                       write-ahead log replays the acked tail and the
+                       retrying client's resend dedups against it. Bars:
+                       loadgen survives (retried >= 1), >= 1 gang
+                       restart, >= 1 server-side duplicate drop, ZERO
+                       lost acked updates (client exactly-once admitted
+                       total == fleet admitted == fleet incorporated,
+                       backlog 0 after the final drain), SLO burn within
+                       ``GATEWAY_BURN_BUDGET``.
+  mp_store_shard_kill  Shard death mid-round: gateway 1 flushes (slot
+                       writeback + pending spool + digest-stamped,
+                       generation-fenced checkpoint), is SIGKILLed, and
+                       gateway 0 ADOPTS its shard — absorbing the
+                       exported store rows and replaying the spooled
+                       pending queue — then takes all traffic via the
+                       client's failover. No gang, deliberately: the
+                       survivor must absorb, not restart. The WHOLE
+                       scenario runs twice and the survivor's tick
+                       history must match BITWISE (virtual-time
+                       determinism on the degraded path), with zero
+                       lost admitted updates and an exact spool
+                       handoff (spooled == replayed).
+
 "History" is the ``--metrics-jsonl`` per-round record with timing
 stripped. Restarted/rolled-back runs append re-executed rounds to the
 same sink, so the comparison takes the LAST record per round — exactly
@@ -100,7 +130,8 @@ from typing import List, Optional, Sequence
 SCENARIOS = ("sigkill", "preempt", "nan_rollback", "dropout", "straggler",
              "mp_kill_worker", "mp_kill_coordinator", "mp_hang",
              "mp_preempt", "mp_shrink", "mp_grow", "mp_shrink_dead",
-             "mp_autoscale_preempt")
+             "mp_autoscale_preempt", "mp_gateway_kill",
+             "mp_store_shard_kill")
 
 # The gang rows: 2 OS processes x 2 virtual CPU devices each, wired into
 # one jax.distributed runtime by `supervise --num-processes 2`. Their
@@ -120,6 +151,14 @@ AUTOSCALE_SCENARIO = "mp_autoscale_preempt"
 # the error budget was consumed exactly as provisioned; the drill
 # deliberately overloads + preempts, so it gets double budget.
 AUTOSCALE_BURN_BUDGET = 2.0
+# The ingestion-tier rows: a 2-gateway fleet instead of a training gang.
+# Like the autoscale drill they need no gang baseline (no run-loop
+# history; the shard row carries its own bitwise bar by running twice).
+GATEWAY_SCENARIOS = ("mp_gateway_kill", "mp_store_shard_kill")
+# mp_gateway_kill's SLO ceiling: a gateway death + gang restart stalls
+# incorporation for the whole restart window, so the tier's burn budget
+# sits above the autoscale drill's.
+GATEWAY_BURN_BUDGET = 2.5
 MP_PROCESSES = 2
 MP_DEVICES_PER_PROC = 2
 # Watchdog budget for the gang rows: far above the tiny CPU job's
@@ -401,9 +440,261 @@ def _run_autoscale_preempt(workdir: str, rounds: int, num_clients: int,
             row["stderr_tail"] = "\n".join(stderr_parts)[-2000:]
 
 
+def _gateway_row(name: str) -> dict:
+    """The shared verdict-row skeleton (every row carries the matrix's
+    common keys so reporting never branches on scenario family)."""
+    return {"scenario": name, "rc": -1, "survived": False,
+            "history_match": True, "faults": 0, "restarts": 0,
+            "rollbacks": 0, "gang_restarts": 0, "collective_hangs": 0,
+            "reshards": 0, "reshard_failures": 0, "ok": False}
+
+
+def _run_gateway_kill(workdir: str, platform: str, timeout: int) -> dict:
+    """mp_gateway_kill (module docstring): 2-gateway fleet under
+    ``supervise --num-processes 2``, gateway 1 SIGKILLs itself in the
+    lost-ack window (ENV_KILL_AFTER), the loadgen rides the retrying
+    client straight through the gang restart."""
+    import signal as _signal
+
+    from fedtpu.serving.admission import ADMITTED
+    from fedtpu.serving.gateway import ENV_KILL_AFTER
+    from fedtpu.serving.traces import synthesize_trace, write_trace
+    name = "mp_gateway_kill"
+    trace = os.path.join(workdir, f"{name}.trace.jsonl")
+    port_base = os.path.join(workdir, f"{name}.port")
+    ck = os.path.join(workdir, f"{name}.ck")
+    hb = os.path.join(workdir, f"{name}.hb")
+    sup_events = os.path.join(workdir, f"{name}.sup.events.jsonl")
+    serve_events = os.path.join(workdir, f"{name}.serve.events.jsonl")
+    header, t, user, lat = synthesize_trace(200, 2400, 20.0, seed=5)
+    write_trace(trace, header, t, user, lat)
+
+    row = _gateway_row(name)
+    row.update({"retried": 0, "reconnects": 0, "duplicate_drops": 0,
+                "lost_acked": None, "backlog": None, "slo_burn": None})
+    env = _child_env()
+    # Gateway 1 dies after ACKING (processing, not answering) its 2nd
+    # update frame — mid-loadgen with frames still to come.
+    env[ENV_KILL_AFTER] = "1:2"
+    sup = None
+    stderr_parts = []
+    try:
+        sup = subprocess.Popen(
+            [sys.executable, "-m", "fedtpu.cli", "supervise",
+             "--heartbeat", hb, "--num-processes", "2",
+             "--max-restarts", "2", "--grace", "10",
+             "--events", sup_events, "--",
+             "gateway", "--platform", platform, "--num-gateways", "2",
+             "--port-file", port_base, "--checkpoint-dir", ck,
+             "--events", serve_events, "--quiet"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        load = subprocess.run(
+            [sys.executable, "-m", "fedtpu.cli", "loadgen", trace,
+             "--port-file", port_base, "--num-gateways", "2",
+             "--batch", "512", "--retries", "10",
+             "--retry-backoff", "0.1", "--quiet", "--json"],
+            env=_child_env(), capture_output=True, text=True,
+            timeout=timeout)
+        row["rc"] = load.returncode
+        if load.returncode != 0:
+            row["error"] = "loadgen failed"
+            stderr_parts.append(load.stderr or "")
+            return row
+        summary = json.loads(load.stdout.strip().splitlines()[-1])
+        row["retried"] = int(summary.get("retried") or 0)
+        row["reconnects"] = int(summary.get("reconnects") or 0)
+
+        per = summary.get("server_stats") or {}
+        stats = [s for s in per.values() if s is not None]
+        sigs = [s.get("signals") or {} for s in stats]
+        row["duplicate_drops"] = sum(
+            int(s.get("duplicate_drops") or 0) for s in stats)
+        client_admitted = sum(
+            int(n) for v, n in (summary.get("admission") or {}).items()
+            if v in ADMITTED)
+        fleet_admitted = sum(int(s.get("admitted") or 0) for s in sigs)
+        fleet_incorporated = sum(int(s.get("incorporated") or 0)
+                                 for s in sigs)
+        row["backlog"] = sum(int(s.get("backlog") or 0) for s in sigs)
+        # Two-sided: a lost acked update breaks it one way, a duplicate
+        # incorporation the other.
+        row["lost_acked"] = client_admitted - fleet_incorporated
+        burns = [s.get("slo_burn") for s in sigs
+                 if s.get("slo_burn") is not None]
+        row["slo_burn"] = max(burns) if burns else None
+
+        sup.send_signal(_signal.SIGTERM)
+        sup_rc = sup.wait(timeout=timeout)
+        res = _resilience(sup_events)
+        row["restarts"] = res.get("restarts") or 0
+        row["gang_restarts"] = res.get("gang_restarts") or 0
+        row["survived"] = sup_rc in (0, 75) and len(stats) == 2
+        row["ok"] = (row["survived"]
+                     and row["retried"] >= 1
+                     and row["gang_restarts"] >= 1
+                     and row["duplicate_drops"] >= 1
+                     and row["lost_acked"] == 0
+                     and client_admitted == fleet_admitted
+                     and row["backlog"] == 0
+                     and row["slo_burn"] is not None
+                     and row["slo_burn"] <= GATEWAY_BURN_BUDGET)
+        if not row["ok"]:
+            stderr_parts.append((sup.stderr.read() or "")
+                                if sup.stderr else "")
+        return row
+    except (subprocess.TimeoutExpired, OSError, ConnectionError,
+            ValueError) as e:
+        row["error"] = f"{type(e).__name__}: {e}"
+        return row
+    finally:
+        if sup is not None and sup.poll() is None:
+            sup.kill()
+            sup.wait(timeout=30)
+        if stderr_parts:
+            row["stderr_tail"] = "\n".join(stderr_parts)[-2000:]
+
+
+def _store_shard_pass(passdir: str, events: list, platform: str,
+                      timeout: int) -> dict:
+    """One mp_store_shard_kill pass (the scenario runs two and compares
+    the survivor histories bitwise): 2 standalone gateways, flush + kill
+    gateway 1 mid-trace, adopt on gateway 0, finish over failover."""
+    import signal as _signal
+    import time as _time
+
+    from fedtpu.serving.client import GatewayClient
+    from fedtpu.serving.loadgen import read_port_file
+    from fedtpu.serving.protocol import gateway_port_file
+    os.makedirs(passdir, exist_ok=True)
+    port_base = os.path.join(passdir, "port")
+    ck = os.path.join(passdir, "ck")
+    hist = os.path.join(passdir, "hist.jsonl")
+    spool = os.path.join(passdir, "shard1.spool.jsonl")
+    out = {"ok": False, "spooled": None, "replayed": None,
+           "adopted_rows": None, "owned": None, "backlog": None,
+           "lost": None, "history": b""}
+    procs = []
+    try:
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "fedtpu.cli", "gateway",
+                 "--platform", platform, "--gateway-index", str(i),
+                 "--num-gateways", "2", "--port-file", port_base,
+                 "--checkpoint-dir", ck, "--total-users", "200",
+                 "--history", hist,
+                 "--events", os.path.join(passdir, "serve.events.jsonl"),
+                 "--quiet"],
+                env=_child_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        for i in range(2):
+            read_port_file(gateway_port_file(port_base, i), timeout=60)
+        half = len(events) // 2
+        with GatewayClient(port_file=port_base, num_gateways=2,
+                           retries=3, backoff_s=0.05, seed=0) as client:
+            for lo in range(0, half, 256):
+                client.send_events(events[lo:min(lo + 256, half)])
+            flushed = client.request({"op": "flush", "path": spool},
+                                     gateway=1, failover=False)
+            if flushed.get("op") != "flushed":
+                out["error"] = f"flush refused: {flushed}"
+                return out
+            out["spooled"] = int(flushed.get("spooled") or 0)
+            procs[1].send_signal(_signal.SIGKILL)
+            procs[1].wait(timeout=30)
+            adopted = client.request(
+                {"op": "adopt", "shard": 1,
+                 "checkpoint_dir": os.path.join(ck, "g1"),
+                 "spool": spool,
+                 "generation": flushed.get("generation")},
+                gateway=0, failover=False)
+            if adopted.get("op") != "adopted":
+                out["error"] = f"adopt refused: {adopted}"
+                return out
+            out["replayed"] = int(adopted.get("replayed") or 0)
+            out["adopted_rows"] = int(adopted.get("rows") or 0)
+            out["owned"] = adopted.get("owned")
+            for lo in range(half, len(events), 256):
+                client.send_events(events[lo:lo + 256])
+            client.request({"op": "drain"}, gateway=0, failover=False)
+            stats = client.request({"op": "stats"}, gateway=0,
+                                   failover=False)
+        sig = stats.get("signals") or {}
+        out["backlog"] = int(sig.get("backlog") or 0)
+        out["lost"] = (int(sig.get("admitted") or 0)
+                       - int(sig.get("incorporated") or 0))
+        procs[0].send_signal(_signal.SIGTERM)
+        rc = procs[0].wait(timeout=timeout)
+        survivor_hist = f"{hist}.g0"
+        deadline = _time.monotonic() + 30
+        while (not os.path.exists(survivor_hist)
+               and _time.monotonic() < deadline):
+            _time.sleep(0.05)
+        with open(survivor_hist, "rb") as fh:
+            out["history"] = fh.read()
+        out["ok"] = (rc in (0, 75)
+                     and out["owned"] == [0, 1]
+                     and out["spooled"] == out["replayed"]
+                     and out["backlog"] == 0
+                     and out["lost"] == 0)
+        if not out["ok"]:
+            out["stderr_tail"] = "\n".join(
+                (p.stderr.read() or "") if p.stderr else ""
+                for p in procs)[-2000:]
+        return out
+    except (subprocess.TimeoutExpired, OSError, ConnectionError,
+            ValueError) as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+def _run_store_shard_kill(workdir: str, platform: str,
+                          timeout: int) -> dict:
+    """mp_store_shard_kill (module docstring): the whole degraded
+    scenario runs TWICE and the survivor's tick history must match
+    bitwise — the determinism verdict for the failover path itself."""
+    from fedtpu.serving.traces import synthesize_trace
+    name = "mp_store_shard_kill"
+    header, t, user, lat = synthesize_trace(200, 2000, 20.0, seed=7)
+    events = [[int(u), float(tt), float(ll)]
+              for u, tt, ll in zip(user, t, lat)]
+    row = _gateway_row(name)
+    row.update({"spooled": None, "replayed": None, "adopted_rows": None,
+                "backlog": None, "lost_updates": None})
+    passes = []
+    for tag in ("a", "b"):
+        p = _store_shard_pass(os.path.join(workdir, f"{name}.{tag}"),
+                              events, platform, timeout)
+        passes.append(p)
+        if not p["ok"]:
+            row["error"] = p.get("error", "pass failed")
+            if "stderr_tail" in p:
+                row["stderr_tail"] = p["stderr_tail"]
+            break
+    a = passes[0]
+    row["rc"] = 0 if all(p["ok"] for p in passes) else 1
+    row["spooled"], row["replayed"] = a["spooled"], a["replayed"]
+    row["adopted_rows"] = a["adopted_rows"]
+    row["backlog"], row["lost_updates"] = a["backlog"], a["lost"]
+    row["survived"] = all(p["ok"] for p in passes)
+    row["history_match"] = (len(passes) == 2 and bool(a["history"])
+                            and a["history"] == passes[1]["history"])
+    row["ok"] = row["survived"] and row["history_match"]
+    return row
+
+
 def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
                  num_clients: int, platform: str, timeout: int) -> dict:
     """One scenario run + verdict row (see module docstring for bars)."""
+    if name == "mp_gateway_kill":
+        return _run_gateway_kill(workdir, platform, timeout)
+    if name == "mp_store_shard_kill":
+        return _run_store_shard_kill(workdir, platform, timeout)
     if name == AUTOSCALE_SCENARIO:
         return _run_autoscale_preempt(workdir, rounds, num_clients,
                                       platform, timeout)
@@ -528,20 +819,27 @@ def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
     wd = workdir or tempfile.mkdtemp(prefix="fedtpu-chaos-")
     os.makedirs(wd, exist_ok=True)
     try:
-        if verbose:
-            print(f"[chaos] baseline run ({rounds} rounds, "
-                  f"{num_clients} clients) in {wd}")
-        base = subprocess.run(
-            [sys.executable, "-m", "fedtpu.cli",
-             *_run_args(wd, "baseline", rounds, num_clients, platform)],
-            env=_child_env(), capture_output=True, text=True,
-            timeout=timeout)
-        if base.returncode != 0:
-            return {"ok": False, "error": "baseline run failed",
-                    "rc": base.returncode,
-                    "stderr_tail": (base.stderr or "")[-2000:],
-                    "scenarios": [], "workdir": wd}
-        baseline = _history(os.path.join(wd, "baseline.metrics.jsonl"))
+        baseline: dict = {}
+        if any(n not in GATEWAY_SCENARIOS for n in names):
+            # The gateway rows carry their own degraded-vs-degraded
+            # baseline inside the scenario; only training rows need the
+            # uninterrupted single-process run.
+            if verbose:
+                print(f"[chaos] baseline run ({rounds} rounds, "
+                      f"{num_clients} clients) in {wd}")
+            base = subprocess.run(
+                [sys.executable, "-m", "fedtpu.cli",
+                 *_run_args(wd, "baseline", rounds, num_clients,
+                            platform)],
+                env=_child_env(), capture_output=True, text=True,
+                timeout=timeout)
+            if base.returncode != 0:
+                return {"ok": False, "error": "baseline run failed",
+                        "rc": base.returncode,
+                        "stderr_tail": (base.stderr or "")[-2000:],
+                        "scenarios": [], "workdir": wd}
+            baseline = _history(os.path.join(wd,
+                                             "baseline.metrics.jsonl"))
 
         dev = MP_PROCESSES * MP_DEVICES_PER_PROC
         if (any(n in MP_SCENARIOS or n == AUTOSCALE_SCENARIO
@@ -596,6 +894,17 @@ def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
                              f"spooled={row['spooled']} "
                              f"lost_updates={row['lost_updates']} "
                              f"slo_burn={row['slo_burn']}")
+                if name == "mp_gateway_kill":
+                    gang += (f" gang_restarts={row['gang_restarts']} "
+                             f"retried={row['retried']} "
+                             f"duplicate_drops={row['duplicate_drops']} "
+                             f"lost_acked={row['lost_acked']} "
+                             f"slo_burn={row['slo_burn']}")
+                if name == "mp_store_shard_kill":
+                    gang += (f" spooled={row['spooled']} "
+                             f"replayed={row['replayed']} "
+                             f"adopted_rows={row['adopted_rows']} "
+                             f"lost_updates={row['lost_updates']}")
                 print(f"[chaos]   {name}: {status} rc={row['rc']} "
                       f"survived={row['survived']} "
                       f"history_match={row['history_match']} "

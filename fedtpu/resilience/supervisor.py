@@ -212,10 +212,10 @@ def supervise(child_argv: Sequence[str], max_restarts: int = 2,
     prefix = (list(_cmd_prefix) if _cmd_prefix is not None
               else [sys.executable, "-m", "fedtpu.cli"])
     base = list(child_argv)
-    # serve children honor the same SIGTERM->drain->checkpoint->75
-    # contract as run (fedtpu.serving.server), so they get the same
+    # serve/gateway children honor the same SIGTERM->drain->checkpoint
+    # ->75 contract as run (fedtpu.serving.server), so they get the same
     # --resume/--heartbeat auto-wiring on restart.
-    is_run = bool(base) and base[0] in ("run", "serve")
+    is_run = bool(base) and base[0] in ("run", "serve", "gateway")
     if heartbeat and is_run and "--heartbeat" not in base:
         base += ["--heartbeat", heartbeat]
 
@@ -434,10 +434,10 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
     prefix = (list(_cmd_prefix) if _cmd_prefix is not None
               else [sys.executable, "-m", "fedtpu.cli"])
     base = list(child_argv)
-    # serve children honor the same SIGTERM->drain->checkpoint->75
-    # contract as run (fedtpu.serving.server), so they get the same
+    # serve/gateway children honor the same SIGTERM->drain->checkpoint
+    # ->75 contract as run (fedtpu.serving.server), so they get the same
     # --resume/--heartbeat auto-wiring on restart.
-    is_run = bool(base) and base[0] in ("run", "serve")
+    is_run = bool(base) and base[0] in ("run", "serve", "gateway")
     if heartbeat and is_run and "--heartbeat" not in base:
         # One base path; each process derives its own file from it
         # (heartbeat_path_for), and _wait_gang watches all of them.

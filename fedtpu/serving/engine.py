@@ -246,6 +246,17 @@ class ServingEngine:
         # Default landing spot for pre_drain() spools (run_server points
         # this at the checkpoint dir); None = caller must pass a path.
         self.spool_dir: Optional[str] = None
+        # Idempotent sessions: nonce -> [high-water seq, last ack counts].
+        # A frame retried after a lost ack replays its ORIGINAL ack
+        # instead of re-incorporating (session_check); checkpointed so
+        # the contract survives a kill+resume.
+        self._sessions: dict = {}
+        self.duplicate_drops = 0
+        # Optional write-ahead log (the gateway sets it): session-stamped
+        # frames are appended BEFORE incorporation, so an ack can never
+        # outlive the update it acknowledged — a SIGKILL between ack and
+        # checkpoint is replayed by replay_wal() on resume.
+        self.wal_path: Optional[str] = None
 
         # The cohort's training fixture: synthetic income-shaped shards,
         # one per slot — serving exercises the ingestion/tick machinery,
@@ -351,24 +362,137 @@ class ServingEngine:
         return counts
 
     # ------------------------------------------------------------------
+    # idempotent sessions + write-ahead log
+
+    def session_check(self, nonce, seq, n_events: int) -> Optional[dict]:
+        """Idempotency gate for a session-stamped frame. None means new
+        work — process it, then :meth:`session_commit`. A frame at or
+        below the session's high-water seq is a client retry after a
+        lost ack: counted as ``serve_duplicate_drop`` (counter + traced
+        event) and answered with the ORIGINAL per-verdict counts when it
+        is the newest frame (exact ack replay — the single-in-flight
+        protocol makes that the only live retry), or a pure
+        ``duplicate`` count for anything older."""
+        if nonce is None or seq is None:
+            return None
+        last = self._sessions.get(str(nonce))
+        if last is None or int(seq) > last[0]:
+            return None
+        n = int(n_events)
+        self.duplicate_drops += n
+        self.registry.counter("serve_duplicate_drop").inc(n)
+        self.tracer.event("serve_duplicate_drop", round=self.tick_count,
+                          nonce=str(nonce), seq=int(seq), events=n)
+        return dict(last[1]) if int(seq) == last[0] else {"duplicate": n}
+
+    def session_commit(self, nonce, seq, counts: dict) -> None:
+        if nonce is None or seq is None:
+            return
+        self._sessions[str(nonce)] = [int(seq), dict(counts)]
+
+    def wal_append(self, nonce, seq, rows) -> None:
+        """Durability write for one admitted frame: rows are
+        ``[user, t, lat]`` (optionally ``+ [version]``). Appended +
+        flushed BEFORE the frame is processed, so every acked update is
+        either in a checkpoint or in the WAL; checkpoint() truncates it
+        once state is durable. No-op until ``wal_path`` is set."""
+        if not self.wal_path:
+            return
+        import json
+        import os
+        os.makedirs(os.path.dirname(self.wal_path) or ".", exist_ok=True)
+        entry = {"nonce": None if nonce is None else str(nonce),
+                 "seq": None if seq is None else int(seq),
+                 "events": [list(r) for r in rows]}
+        with open(self.wal_path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(entry, sort_keys=True,
+                                separators=(",", ":")) + "\n")
+            fh.flush()
+
+    def replay_wal(self) -> int:
+        """Resume path: re-offer every WAL frame the restored checkpoint
+        does not already cover. Idempotent two ways — frames the
+        checkpoint saw are skipped by session_check (their seq is at or
+        below the restored high-water mark), and the replay itself
+        commits sessions so the client's own retries dedup afterwards.
+        Ordered file replay against the restored state reproduces the
+        original verdicts (virtual-time determinism). Returns the number
+        of events re-offered; a torn tail line (the kill mid-append)
+        ends the replay cleanly."""
+        import json
+        import os
+        if not self.wal_path or not os.path.exists(self.wal_path):
+            return 0
+        replayed = 0
+        with open(self.wal_path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    break  # torn tail write: nothing after it is valid
+                rows = entry.get("events") or []
+                if self.session_check(entry.get("nonce"),
+                                      entry.get("seq"), len(rows)) is not None:
+                    continue
+                counts: dict = {}
+                for r in rows:
+                    v = self.offer(float(r[1]), int(r[0]), float(r[2]),
+                                   version=(int(r[3]) if len(r) > 3
+                                            else None))
+                    counts[v] = counts.get(v, 0) + 1
+                    replayed += 1
+                self.session_commit(entry.get("nonce"), entry.get("seq"),
+                                    counts)
+        if replayed:
+            self.tracer.event("serve_wal_replay", round=self.tick_count,
+                              events=replayed)
+        return replayed
+
+    # ------------------------------------------------------------------
     # per-user identity (cohort store backing)
 
     def attach_store(self, total_users: int, backend: str = "memory",
-                     path: Optional[str] = None):
+                     path: Optional[str] = None, shard_index: int = 0,
+                     num_shards: int = 1):
         """Back slot eviction with a per-user state store: each of
         ``total_users`` user ids owns one record shaped like a single
         engine slot (params, anchor, optimizer moments, pull tick).
         From now on, evicting a user persists its slot into its record,
         and a returning user's record is loaded back into the slot it
         lands on — true per-user identity over a population far larger
-        than the C device slots. Returns the store (callers checkpoint
+        than the C device slots. ``shard_index``/``num_shards`` attach
+        the id-shard a gateway owns (the fleet's routing keeps every
+        offered user inside it). Returns the store (callers checkpoint
         it through :meth:`checkpoint`, which attaches its touched rows
         to the same orbax commit as the engine state)."""
         from fedtpu.cohort.store import ClientStateStore, state_template
         self.store = ClientStateStore(
             state_template(self.state, self.C), total_users,
-            backend=backend, path=path)
+            backend=backend, path=path, shard_index=shard_index,
+            num_shards=num_shards)
         return self.store
+
+    def writeback_slots(self) -> int:
+        """Persist every currently-BOUND slot's engine state into its
+        user's store record, without evicting — completes the store
+        image before a shard export (the gateway ``flush`` op), so a
+        survivor adopting the records sees every user's newest state,
+        not just past evictees'. Returns the number of slots written."""
+        if self.store is None:
+            return 0
+        from fedtpu.parallel.async_fed import read_client_slot
+        bind = self.binder.state()
+        for user, slot in zip(bind["users"].tolist(),
+                              bind["slots"].tolist()):
+            vals = read_client_slot(self.state, self.C, int(slot))
+            self.store.write(
+                np.asarray([user], np.int64),
+                [np.asarray(v)[None] for v in vals],  # fedtpu: noqa[FTP001] export-time writeback, off the tick hot path
+                participated=False)
+        return int(bind["users"].size)
 
     def _swap_slot(self, slot: int, evicted_user: int,
                    new_user: int) -> None:
@@ -504,6 +628,7 @@ class ServingEngine:
             "pending": len(self.pending),
             "buffered": float(self.nbuf_host),
             "admission": dict(self.admission.counts),
+            "duplicate_drops": self.duplicate_drops,
             "update_to_incorporation": (_percentiles(self.latencies)
                                         if self.latencies else None),
             "wall_s": wall,
@@ -652,12 +777,27 @@ class ServingEngine:
         if bind["users"].size:
             extra["bind_users"] = bind["users"]
             extra["bind_slots"] = bind["slots"]
+        # Idempotency sessions: without them a resumed engine would
+        # re-incorporate a client's post-kill retries.
+        extra["serve_duplicate_drops"] = np.int64(self.duplicate_drops)
+        if self._sessions:
+            import json
+            extra["serve_sessions"] = np.frombuffer(
+                json.dumps(self._sessions, sort_keys=True).encode(),
+                np.uint8).copy()
         # Attached user store: its touched records ride the same orbax
         # commit, so engine state and store restore atomically.
         if self.store is not None:
             extra.update(self.store.checkpoint_arrays())
-        return save_checkpoint(directory, self.state, self.history,
+        path = save_checkpoint(directory, self.state, self.history,
                                self.tick_count, extra_meta=extra)
+        # Everything the WAL guards is now durable; truncate so resume
+        # replays only the post-checkpoint tail.
+        if self.wal_path:
+            import os
+            if os.path.exists(self.wal_path):
+                open(self.wal_path, "w").close()
+        return path
 
     def restore(self, directory: str) -> int:
         """Restore engine + serving host state from the newest checkpoint
@@ -717,6 +857,17 @@ class ServingEngine:
                 np.atleast_1d(meta["bind_users"]),
                 np.atleast_1d(meta["bind_slots"]),
                 int(np.asarray(meta.get("bind_evictions", 0))))
+        self.duplicate_drops = int(np.asarray(
+            meta.get("serve_duplicate_drops", 0)))
+        if self.duplicate_drops:
+            self.registry.counter("serve_duplicate_drop").inc(
+                self.duplicate_drops)
+        if meta.get("serve_sessions") is not None:
+            import json
+            raw = np.atleast_1d(meta["serve_sessions"]).astype(np.uint8)
+            self._sessions = {
+                k: [int(v[0]), dict(v[1])]
+                for k, v in json.loads(bytes(raw).decode()).items()}
         if self.store is not None:
             self.store.restore_arrays(meta)
         # Re-seed the run-total registry instruments so a post-resume

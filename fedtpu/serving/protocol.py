@@ -34,11 +34,25 @@ infers it from ``t - lat`` against its own apply history. The batch
 thousands of arrivals is what lets the loadgen replay millions of
 simulated users through a single socket.
 
+Idempotent sessions (v1, optional fields): ``update``/``updates`` frames
+may carry ``"nonce"`` (a per-client-session identifier that SURVIVES
+socket reconnects) and ``"seq"`` (monotonic per nonce, one per frame).
+The engine remembers each session's high-water seq and its last ack, so
+a frame retried after a lost ack is answered with the ORIGINAL counts
+(flagged ``"duplicate": true``) instead of being incorporated twice —
+the exactly-once contract the retrying gateway client leans on.
+
+Gateway routing (fedtpu.serving.gateway): a frame for a user another
+gateway owns is refused with an error frame carrying a ``"redirect"``
+object naming the owner — ``{"gateway": g, "num_gateways": N,
+"port_file": ...}`` — which the retrying client follows.
+
 Anything unparseable or unknown gets ``{"op": "error", ...}`` and the
 connection stays up — a load generator mid-replay should not lose its
 socket to one malformed frame.
 
-Framing helpers below are shared by server and loadgen; stdlib only.
+Framing helpers below are shared by server, gateway, and loadgen;
+stdlib only.
 """
 
 from __future__ import annotations
@@ -97,6 +111,14 @@ def parse_msg(line: bytes) -> Optional[dict]:
 
 def error_msg(reason: str) -> dict:
     return {"op": "error", "v": PROTOCOL_VERSION, "reason": reason}
+
+
+def gateway_port_file(base: str, index: int) -> str:
+    """Per-gateway port-file path (``<base>.g<i>``) — the one derivation
+    rule shared by the gateway fleet, its clients, and the health probe,
+    so a redirect frame's owner is discoverable from the base path
+    alone."""
+    return f"{base}.g{int(index)}"
 
 
 class Connection:

@@ -72,12 +72,24 @@ def _handle(engine: ServingEngine, msg: dict) -> dict:
         return {"op": "welcome", "v": protocol.PROTOCOL_VERSION,
                 "cohort": engine.C, "version": engine.version}
     if op == "update":
+        nonce, seq = msg.get("nonce"), msg.get("seq")
+        cached = engine.session_check(nonce, seq, 1)
+        if cached is not None:
+            verdict = ("duplicate" if "duplicate" in cached
+                       else next(iter(cached)))
+            return {"op": "ack", "verdict": verdict,
+                    "version": engine.version, "duplicate": True}
         try:
-            verdict = engine.offer(float(msg["t"]), int(msg["user"]),
-                                   float(msg.get("lat", 0.0)),
-                                   version=msg.get("version"))
+            row = [int(msg["user"]), float(msg["t"]),
+                   float(msg.get("lat", 0.0))]
+            if msg.get("version") is not None:
+                row.append(int(msg["version"]))
         except (KeyError, TypeError, ValueError) as e:
             return protocol.error_msg(f"bad update frame: {e}")
+        engine.wal_append(nonce, seq, [row])
+        verdict = engine.offer(row[1], row[0], row[2],
+                               version=(row[3] if len(row) > 3 else None))
+        engine.session_commit(nonce, seq, {verdict: 1})
         return {"op": "ack", "verdict": verdict, "version": engine.version}
     if op == "updates":
         events = msg.get("events")
@@ -87,10 +99,18 @@ def _handle(engine: ServingEngine, msg: dict) -> dict:
             return protocol.error_msg(
                 f"batch of {len(events)} exceeds "
                 f"MAX_BATCH_EVENTS={protocol.MAX_BATCH_EVENTS}")
+        nonce, seq = msg.get("nonce"), msg.get("seq")
+        cached = engine.session_check(nonce, seq, len(events))
+        if cached is not None:
+            return {"op": "acks", "n": len(events), "counts": cached,
+                    "version": engine.version, "tick": engine.tick_count,
+                    "duplicate": True}
+        engine.wal_append(nonce, seq, events)
         try:
             counts = engine.offer_many(events)
         except (TypeError, ValueError, IndexError) as e:
             return protocol.error_msg(f"bad events row: {e}")
+        engine.session_commit(nonce, seq, counts)
         return {"op": "acks", "n": len(events), "counts": counts,
                 "version": engine.version, "tick": engine.tick_count}
     if op == "stats":
@@ -117,14 +137,14 @@ def _handle(engine: ServingEngine, msg: dict) -> dict:
 
 
 def _safe_handle(engine: ServingEngine, msg: Optional[dict], tracer,
-                 registry) -> dict:
-    """:func:`_handle` behind a crash barrier: an unexpected exception
+                 registry, handler=_handle) -> dict:
+    """``handler`` behind a crash barrier: an unexpected exception
     becomes an ``error`` frame (counted as ``serve_handler_errors`` and
     traced) instead of escaping the single-threaded loop and killing the
     whole server for every connection. ``Preempted``/KeyboardInterrupt
     are BaseException and pass through untouched."""
     try:
-        return (_handle(engine, msg) if msg is not None
+        return (handler(engine, msg) if msg is not None
                 else protocol.error_msg("malformed frame"))
     except Exception as e:
         op = msg.get("op") if isinstance(msg, dict) else None
@@ -142,7 +162,8 @@ def run_server(cfg, *, events: Optional[str] = None,
                history_path: Optional[str] = None,
                heartbeat: Optional[str] = None,
                once: bool = False, resume: bool = False,
-               verbose: bool = True) -> dict:
+               verbose: bool = True, handle=None, on_engine=None,
+               start_extra: Optional[dict] = None) -> dict:
     """Serve until SIGTERM (raises ``Preempted`` after the drain) or,
     with ``once=True``, until the first accepted connection closes
     (clean drain, returns the summary). ``cfg`` is a ServingConfig.
@@ -150,6 +171,14 @@ def run_server(cfg, *, events: Optional[str] = None,
     ``port_file``: the bound port is written here once listening —
     ephemeral-port discovery for loadgen/tests. ``checkpoint_every_ticks``
     adds periodic checkpoints on top of the drain-time one.
+
+    The gateway (fedtpu.serving.gateway) reuses this loop wholesale:
+    ``handle`` replaces the per-request dispatcher (same ``(engine, msg)
+    -> response`` shape as :func:`_handle`), ``on_engine`` runs once
+    after engine construction but before resume (store attach, WAL
+    wiring), and ``start_extra`` merges extra identity fields into the
+    ``serve_start`` event (e.g. the gateway index fedtpu report groups
+    the merged fleet view by).
     """
     from fedtpu.resilience.supervisor import Preempted, write_heartbeat
     from fedtpu.telemetry import make_tracer
@@ -161,6 +190,8 @@ def run_server(cfg, *, events: Optional[str] = None,
     engine = ServingEngine(cfg, registry=registry, tracer=tracer)
     if checkpoint_dir:
         engine.spool_dir = checkpoint_dir
+    if on_engine is not None:
+        on_engine(engine)
     if resume and checkpoint_dir:
         from fedtpu.orchestration.checkpoint import latest_step
         if latest_step(checkpoint_dir) is not None:
@@ -169,6 +200,12 @@ def run_server(cfg, *, events: Optional[str] = None,
                 log.info(f"resumed serving state at tick {step} "
                          f"(version {engine.version}, "
                          f"{len(engine.pending)} pending)")
+        # WAL tail: acked frames the kill beat the checkpoint to. Runs
+        # even with no checkpoint yet (a first-checkpoint-window kill).
+        replayed = engine.replay_wal()
+        if replayed and verbose:
+            log.info(f"replayed {replayed} acked update(s) from the "
+                     "write-ahead log")
 
     # SIGTERM -> drain flag, main thread only (signal.signal's rule);
     # elsewhere (tests driving run_server from a worker thread) external
@@ -196,7 +233,8 @@ def run_server(cfg, *, events: Optional[str] = None,
         log.info(f"serving on {cfg.host}:{port} (cohort={cfg.cohort}, "
                  f"buffer_size={cfg.buffer_size}, once={once})")
     tracer.event("serve_start", port=port, cohort=cfg.cohort,
-                 buffer_size=cfg.buffer_size, resume=bool(resume))
+                 buffer_size=cfg.buffer_size, resume=bool(resume),
+                 **(start_extra or {}))
 
     sel = selectors.DefaultSelector()
     sel.register(lsock, selectors.EVENT_READ, None)
@@ -252,7 +290,8 @@ def run_server(cfg, *, events: Optional[str] = None,
                 try:
                     for line in protocol.recv_lines(conn.sock, conn.buf):
                         msg = protocol.parse_msg(line)
-                        resp = _safe_handle(engine, msg, tracer, registry)
+                        resp = _safe_handle(engine, msg, tracer, registry,
+                                            handle or _handle)
                         protocol.send_msg(conn.sock, resp)
                 except (ConnectionError, OSError):
                     sel.unregister(conn.sock)

@@ -79,6 +79,7 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     diverged_at: Optional[dict] = None
     supervisor_exit: Optional[dict] = None
     serve_ticks = 0
+    serve_start: Optional[dict] = None
     serve_last: Optional[dict] = None
     serve_summary: Optional[dict] = None
     starvation: List[dict] = []
@@ -169,6 +170,11 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         # summary carries the authoritative SLO numbers (admission
         # counts, update-to-incorporation percentiles, rounds/sec);
         # per-tick events supply the cadence when a run died pre-drain.
+        elif kind == "serve_start":
+            # LAST start wins: a supervised restart re-emits it, and the
+            # current launch's identity (gateway index, generation) is
+            # the one the merged fleet view should group by.
+            serve_start = dict(payload)
         elif kind == "serve_tick":
             serve_ticks += 1
             serve_last = {"tick": e.get("round"), **payload}
@@ -235,9 +241,10 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             "serve_configures": serve_configures,
             "summary": autoscale_summary,
         }
-    if serve_ticks or serve_summary or starvation:
+    if serve_ticks or serve_summary or starvation or serve_start:
         out["serving"] = {
             "ticks": serve_ticks,
+            "start": serve_start,
             "last_tick": serve_last,
             "summary": serve_summary,
             "starvation": starvation,
@@ -500,11 +507,27 @@ def render_text(agg: dict) -> str:
             lines.append("  summary: " + ", ".join(
                 f"{k}={summ[k]}" for k in sorted(summ)
                 if not isinstance(summ[k], (dict, list))))
+    fleet = agg.get("gateway_fleet")
+    if fleet:
+        lines.append("gateway fleet (merged):")
+        lines.append("  gateways: " + ", ".join(
+            str(g) for g in fleet["gateways"]))
+        if fleet.get("admission"):
+            lines.append("  admission: " + ", ".join(
+                f"{k}={fleet['admission'][k]:g}"
+                for k in sorted(fleet["admission"])))
+        lines.append(f"  incorporated: {fleet['incorporated']}")
+        lines.append(f"  duplicate_drops: {fleet['duplicate_drops']}")
+        if fleet.get("slo_burn_max") is not None:
+            lines.append(f"  slo_burn (worst member): "
+                         f"{fleet['slo_burn_max']:.3f}")
     srcs = agg.get("sources")
     if srcs:
         lines.append("per-source view:")
         for s in srcs:
-            lines.append(f"  {s['path']}: {s['events']} event(s)")
+            tag = (f" [gateway {s['gateway']}]"
+                   if s.get("gateway") is not None else "")
+            lines.append(f"  {s['path']}{tag}: {s['events']} event(s)")
             adm = s.get("admission")
             if adm:
                 lines.append("    admission: " + ", ".join(
@@ -577,15 +600,43 @@ def render_prometheus(agg: dict) -> str:
 
 def _source_view(path: str, events: List[dict], bad: int) -> dict:
     """The per-source admission/SLO slice of one log — what the merged
-    report shows next to the combined numbers."""
+    report shows next to the combined numbers. Gateway sources (a
+    ``serve_start`` carrying a fleet index) additionally expose the
+    identity + dedup/incorporation totals the merged fleet view sums."""
     agg = aggregate(events, malformed=bad)
-    summ = ((agg.get("serving") or {}).get("summary")
-            or (agg.get("serving") or {}).get("last_tick") or {})
+    srv = agg.get("serving") or {}
+    summ = srv.get("summary") or srv.get("last_tick") or {}
     signals = summ.get("signals") or {}
+    start = srv.get("start") or {}
     return {"path": path, "events": len(events),
+            "gateway": start.get("gateway"),
             "admission": summ.get("admission"),
+            "incorporated": summ.get("incorporated"),
+            "duplicate_drops": summ.get("duplicate_drops"),
             "update_to_incorporation": summ.get("update_to_incorporation"),
             "slo_burn": signals.get("slo_burn")}
+
+
+def _fleet_view(sources: List[dict]) -> dict:
+    """The merged admission/SLO view over >= 2 gateway sources: summed
+    admission counts, incorporation and dedup totals, and the WORST
+    member's SLO burn (a fleet meets its objective only if every shard
+    does)."""
+    admission: dict = {}
+    for s in sources:
+        for k, v in (s.get("admission") or {}).items():
+            admission[k] = admission.get(k, 0) + int(v)
+    burns = [s["slo_burn"] for s in sources
+             if s.get("slo_burn") is not None]
+    return {
+        "gateways": sorted(int(s["gateway"]) for s in sources),
+        "admission": admission,
+        "incorporated": sum(int(s.get("incorporated") or 0)
+                            for s in sources),
+        "duplicate_drops": sum(int(s.get("duplicate_drops") or 0)
+                               for s in sources),
+        "slo_burn_max": max(burns) if burns else None,
+    }
 
 
 def render_report(path, fmt: str = "text",
@@ -614,6 +665,10 @@ def render_report(path, fmt: str = "text",
     if len(paths) > 1:
         agg["sources"] = [_source_view(p, ev, b)
                           for p, ev, b in per_source]
+        fleet = [s for s in agg["sources"]
+                 if s.get("gateway") is not None]
+        if len(fleet) >= 2:
+            agg["gateway_fleet"] = _fleet_view(fleet)
     if heartbeat:
         from fedtpu.autoscale.signals import read_gang_members
         agg["heartbeats"] = [

@@ -215,6 +215,13 @@ QUICK_TESTS = {
     "_hot_ticks",
     "test_autoscale.py::test_signal_bus_folds_stats_and_prefers"
     "_exported_burn",
+    # round-11 modules
+    # gateway fleet (routing/redirect/session-dedup are backend-free or
+    # tiny-engine, milliseconds-to-seconds; the socket fleet and chaos
+    # rows stay full-tier)
+    "test_gateway.py::test_owner_of_and_redirect_msg",
+    "test_gateway.py::test_client_partition_matches_gateway_owner",
+    "test_gateway.py::test_retried_frame_incorporated_exactly_once",
 }
 
 

@@ -184,6 +184,113 @@ def test_store_guards(tmp_path):
         ClientStateStore(template, 4, shard_index=2, num_shards=2)
 
 
+# ------------------------------------------------- shard failover (ISSUE 12)
+
+def _two_shards(total=11):
+    """The gateway-fleet partition: two shards over one population."""
+    template = [((3,), np.dtype(np.float32)), ((2,), np.dtype(np.int32))]
+    return [ClientStateStore(template, total, shard_index=i, num_shards=2)
+            for i in range(2)]
+
+
+def test_two_shard_partition_is_disjoint_and_exhaustive():
+    """The 2-process ownership contract the gateway fleet routes by:
+    owns() masks are disjoint AND exhaustive over the population, both
+    before and after a failover absorb flips shard 1's ids to shard 0."""
+    s0, s1 = _two_shards()
+    ids = np.arange(11, dtype=np.int64)
+    assert not (s0.owns(ids) & s1.owns(ids)).any()      # disjoint
+    assert (s0.owns(ids) | s1.owns(ids)).all()          # exhaustive
+    assert s0.rows + s1.rows == 11
+    # After the survivor absorbs the dead shard, its mask alone covers
+    # the whole population — the fleet keeps answering for every id.
+    s1.generation = "g"
+    s0.absorb_shard(s1.checkpoint_arrays(), expected_generation="g")
+    assert s0.owns(ids).all()
+
+
+def test_shard_handoff_roundtrip_is_bitwise():
+    """Flush-export from the dying shard, absorb into the survivor: the
+    absorbed rows read back bitwise (records, versions, keys), and
+    writes to adopted ids keep working through the overlay."""
+    s0, s1 = _two_shards()
+    rng = np.random.default_rng(3)
+    ids = np.array([1, 5, 9], np.int64)                 # shard-1 ids
+    leaves = [rng.normal(size=(3, 3)).astype(np.float32),
+              rng.integers(0, 9, size=(3, 2)).astype(np.int32)]
+    keys = rng.integers(0, 2**32, size=(3, 2), dtype=np.uint32)
+    s1.write(ids, leaves, keys=keys)
+    s1.generation = "launchA"
+    assert s0.absorb_shard(s1.checkpoint_arrays(),
+                           expected_generation="launchA") == 3
+    for want, have in zip(s1.read(ids), s0.read(ids)):
+        np.testing.assert_array_equal(want, have)
+    np.testing.assert_array_equal(s0.versions(ids), s1.versions(ids))
+    np.testing.assert_array_equal(s0.read_keys(ids), keys)
+    # The survivor's own checkpoint now carries the adopted ids, so a
+    # post-failover resume keeps answering for them (store_absorbed).
+    arrs = s0.checkpoint_arrays()
+    assert arrs["store_absorbed"].tolist() == [1]
+    s2 = ClientStateStore(s0.template, s0.total_clients, shard_index=0,
+                          num_shards=2)
+    s2.restore_arrays(arrs)
+    for want, have in zip(s0.read(ids), s2.read(ids)):
+        np.testing.assert_array_equal(want, have)
+    # Adopted ids stay writable (version bumps ride the overlay).
+    s0.write(ids[:1], [l[:1] for l in leaves])
+    assert s0.versions(ids).tolist()[0] == 2
+
+
+def test_shard_export_digest_and_generation_fences():
+    """Corrupt or stale exports are refused loudly: a tampered record
+    fails the sha256 digest, a wrong generation fails the fence, and a
+    wrong-shard id set is rejected."""
+    s0, s1 = _two_shards()
+    s1.write(np.array([1, 3], np.int64),
+             [np.ones((2, 3), np.float32),
+              np.ones((2, 2), np.int32)])
+    s1.generation = "live"
+    good = s1.checkpoint_arrays()
+
+    tampered = dict(good)
+    recs = good["store_records"].copy()
+    recs[0, 0] ^= 0xFF
+    tampered["store_records"] = recs
+    with pytest.raises(ValueError, match="digest mismatch"):
+        s0.absorb_shard(tampered, expected_generation="live")
+
+    with pytest.raises(ValueError, match="stale handoff"):
+        s0.absorb_shard(good, expected_generation="previous-life")
+
+    own = dict(good)
+    own["store_shard_index"] = np.int64(0)   # "absorb yourself"
+    with pytest.raises(ValueError, match="cannot absorb"):
+        s0.absorb_shard(own, expected_generation="live")
+
+
+def test_restore_arrays_verifies_digest_and_shard_identity():
+    """restore_arrays (the checkpoint path) applies the same fences: a
+    truncated/overwritten restore fails the digest check and a
+    checkpoint from another shard is refused."""
+    s0, s1 = _two_shards()
+    s1.write(np.array([1], np.int64),
+             [np.full((1, 3), 2.0, np.float32),
+              np.full((1, 2), 4, np.int32)])
+    arrs = s1.checkpoint_arrays()
+
+    fresh = ClientStateStore(s1.template, s1.total_clients, shard_index=1,
+                             num_shards=2)
+    corrupt = dict(arrs)
+    recs = arrs["store_records"].copy()
+    recs[0, -1] ^= 0xFF
+    corrupt["store_records"] = recs
+    with pytest.raises(ValueError, match="digest mismatch"):
+        fresh.restore_arrays(corrupt)
+
+    with pytest.raises(ValueError, match="belongs to shard"):
+        s0.restore_arrays(arrs)          # shard-1 checkpoint into shard 0
+
+
 # ------------------------------------------------------------------ parity
 
 def test_cohort_full_participation_bitwise_equals_vmap():

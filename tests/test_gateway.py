@@ -1,0 +1,305 @@
+"""fedtpu.serving.gateway + the retrying GatewayClient (ISSUE 12).
+
+Pins the fault-tolerant multi-host ingestion contracts:
+- the modular ownership rule and the redirect error frame shape;
+- redirect-atomic batches: ANY foreign event refuses the whole frame,
+  the session seq is NOT committed, nothing is admitted;
+- idempotent sessions: a retried update frame (the lost-ack window) is
+  deduplicated against the engine's incorporation counters and answered
+  with the ORIGINAL counts — the exactly-once acceptance bar;
+- the write-ahead log replays acked-but-uncheckpointed updates into a
+  fresh engine bitwise, and the client's post-replay retries still
+  dedup;
+- the flush/adopt shard-failover handoff round-trips the dead shard's
+  rows bitwise, fences on generation, and replays its spooled queue;
+- a real 2-gateway in-process fleet serves a partitioned loadgen path
+  end to end (redirect following included);
+- probe_fleet reports per-member liveness without raising.
+
+The chaos rows themselves (supervised gang + SIGKILL) are `slow`-marked
+subprocess tests delegating to fedtpu.resilience.chaos.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from fedtpu.config import ServingConfig
+from fedtpu.serving import protocol
+from fedtpu.serving.client import GatewayClient
+from fedtpu.serving.gateway import (_Gateway, _gateway_handle, owner_of,
+                                    probe_fleet, redirect_msg, run_gateway)
+from fedtpu.telemetry.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _small_cfg(**kw):
+    base = dict(cohort=8, buffer_size=2, tick_interval_s=0.5,
+                data_rows=64, model_hidden=(8,), seed=0)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _engine(**kw):
+    from fedtpu.serving.engine import ServingEngine
+    return ServingEngine(_small_cfg(tick_interval_s=0.0, **kw),
+                         registry=MetricsRegistry())
+
+
+# ------------------------------------------------------------------ routing
+
+def test_owner_of_and_redirect_msg():
+    assert owner_of(5, 2) == 1 and owner_of(4, 2) == 0
+    assert owner_of(7, 1) == 0
+    assert owner_of(3, 0) == 0          # degenerate fleet clamps to 1
+    msg = redirect_msg(5, 1, 2, "/tmp/base")
+    assert msg["op"] == "error"
+    assert msg["redirect"]["gateway"] == 1
+    assert msg["redirect"]["num_gateways"] == 2
+    assert (msg["redirect"]["port_file"]
+            == protocol.gateway_port_file("/tmp/base", 1))
+    # Without a port-file base the redirect still names the owner.
+    assert "port_file" not in redirect_msg(5, 1, 2, None)["redirect"]
+
+
+def test_gateway_ownership_tracks_adoption():
+    gw = _Gateway(0, 2, None, "gen", None)
+    assert gw.owns_user(0) and gw.owns_user(4) and not gw.owns_user(1)
+    gw.owned.add(1)                     # the post-adopt state
+    assert gw.owns_user(1) and gw.owns_user(3)
+
+
+def test_client_partition_matches_gateway_owner():
+    c = GatewayClient(port=1, num_gateways=3)
+    assert all(c.owner_of(u) == owner_of(u, 3) for u in range(12))
+    # The idempotency stamp: one nonce per CLIENT, monotonic seq.
+    a, b = c.stamped({"op": "updates"}), c.stamped({"op": "updates"})
+    assert a["nonce"] == b["nonce"] == c.nonce
+    assert b["seq"] == a["seq"] + 1
+
+
+# ------------------------------------------------- idempotent sessions + WAL
+
+def test_retried_frame_incorporated_exactly_once():
+    """THE dedup acceptance bar: a retried updates frame (simulated
+    dropped ack) is absorbed by the session cache — answered with the
+    ORIGINAL counts, flagged duplicate, counted as serve_duplicate_drop
+    — and the engine's admission/incorporation counters do not move."""
+    from fedtpu.serving.server import _handle
+
+    eng = _engine()
+    frame = {"op": "updates", "events": [[1, 0.1, 0.0], [2, 0.2, 0.0]],
+             "nonce": "n1", "seq": 1}
+    first = _handle(eng, frame)
+    assert first["op"] == "acks" and "duplicate" not in first
+    counts_after_first = dict(eng.admission.counts)
+    second = _handle(eng, dict(frame))   # the lost-ack retry
+    assert second["op"] == "acks" and second["duplicate"] is True
+    assert second["counts"] == first["counts"]
+    assert dict(eng.admission.counts) == counts_after_first
+    assert eng.duplicate_drops == 2      # both retried events dropped
+    snap = eng.registry.snapshot()["counters"]
+    assert snap["serve_duplicate_drop"] == 2
+    eng.drain()
+    assert eng.incorporated == 2         # exactly once, never four
+
+
+def test_wal_replays_acked_updates_into_fresh_engine(tmp_path):
+    """SIGKILL between processing and checkpoint: every acked frame is
+    in the WAL, so a fresh engine replaying it reaches the same
+    incorporated state as an uninterrupted run — and the client's retry
+    of the lost-ack frame still dedups after the replay."""
+    from fedtpu.serving.server import _handle
+
+    wal = str(tmp_path / "wal.jsonl")
+    ev1, ev2 = [[1, 0.1, 0.0], [2, 0.2, 0.0]], [[3, 0.3, 0.0]]
+    a = _engine()
+    a.wal_path = wal
+    _handle(a, {"op": "updates", "events": ev1, "nonce": "n", "seq": 1})
+    r2 = _handle(a, {"op": "updates", "events": ev2, "nonce": "n",
+                     "seq": 2})
+    # Engine a dies here (no checkpoint); only the WAL survives.
+    b = _engine()
+    b.wal_path = wal
+    assert b.replay_wal() == 3
+    r2b = _handle(b, {"op": "updates", "events": ev2, "nonce": "n",
+                      "seq": 2})
+    assert r2b["duplicate"] is True and r2b["counts"] == r2["counts"]
+    b.drain()
+    c = _engine()
+    c.offer_many([tuple(r) for r in ev1 + ev2])
+    c.drain()
+    assert b.incorporated == c.incorporated == 3
+    assert b.history_lines() == c.history_lines()
+
+
+# ------------------------------------------------------ the gateway handler
+
+def test_gateway_handle_redirects_and_keeps_batches_atomic():
+    eng = _engine()
+    gw = _Gateway(0, 2, "/tmp/pf", "gen0", None)
+    w = _gateway_handle(gw, eng, {"op": "hello",
+                                  "v": protocol.PROTOCOL_VERSION})
+    assert w["op"] == "welcome" and w["gateway"] == 0
+    assert w["num_gateways"] == 2 and w["owned"] == [0]
+    assert w["generation"] == "gen0"
+    # Owned update passes through to the base handler.
+    assert _gateway_handle(gw, eng, {"op": "update", "user": 2,
+                                     "t": 0.1})["op"] == "ack"
+    # Foreign single update: redirect naming the owner + its port file.
+    r = _gateway_handle(gw, eng, {"op": "update", "user": 3, "t": 0.1})
+    assert r["op"] == "error" and r["redirect"]["gateway"] == 1
+    assert (r["redirect"]["port_file"]
+            == protocol.gateway_port_file("/tmp/pf", 1))
+    # Redirect-atomic batch: ONE foreign event refuses the whole frame,
+    # nothing is admitted, and the seq is NOT committed — the
+    # re-partitioned resend under the same stamp is new work.
+    counts0 = dict(eng.admission.counts)
+    rb = _gateway_handle(gw, eng, {"op": "updates",
+                                   "events": [[0, 0.2, 0.0],
+                                              [1, 0.2, 0.0]],
+                                   "nonce": "x", "seq": 1})
+    assert rb["op"] == "error" and rb["redirect"]["owners"] == {"1": 1}
+    assert dict(eng.admission.counts) == counts0
+    ok = _gateway_handle(gw, eng, {"op": "updates",
+                                   "events": [[0, 0.2, 0.0]],
+                                   "nonce": "x", "seq": 1})
+    assert ok["op"] == "acks" and "duplicate" not in ok
+    assert gw.redirects == 2
+    snap = eng.registry.snapshot()["counters"]
+    assert snap["gateway_redirects"] == 2
+
+
+def test_flush_adopt_handoff_roundtrip_is_bitwise(tmp_path):
+    """The store-shard failover: g1 flushes (writeback + spool +
+    digest-stamped, generation-fenced checkpoint), dies; g0 adopts —
+    rows land bitwise, the id range moves, the spooled queue replays,
+    and a stale-generation export is refused."""
+    e0, e1 = _engine(), _engine()
+    s0 = e0.attach_store(40, shard_index=0, num_shards=2)
+    s1 = e1.attach_store(40, shard_index=1, num_shards=2)
+    s0.generation = s1.generation = "genA"
+    gw0 = _Gateway(0, 2, None, "genA", str(tmp_path / "g0"))
+    gw1 = _Gateway(1, 2, None, "genA", str(tmp_path / "g1"))
+    for u in (1, 3, 5):
+        assert _gateway_handle(gw1, e1, {"op": "update", "user": u,
+                                         "t": 0.1})["op"] == "ack"
+    e1.drain()                           # bind + incorporate the slots
+    # One admitted-but-unincorporated update left pending to spool.
+    _gateway_handle(gw1, e1, {"op": "update", "user": 7, "t": 9.9})
+    fl = _gateway_handle(gw1, e1, {"op": "flush",
+                                   "path": str(tmp_path / "spool.jsonl")})
+    assert fl["op"] == "flushed" and fl["generation"] == "genA"
+    assert fl["spooled"] == 1 and fl["slots"] >= 1
+
+    bad = _gateway_handle(gw0, e0, {"op": "adopt", "shard": 1,
+                                    "checkpoint_dir": str(tmp_path / "g1"),
+                                    "generation": "genB"})
+    assert bad["op"] == "error" and "generation" in bad["reason"]
+
+    ad = _gateway_handle(gw0, e0, {"op": "adopt", "shard": 1,
+                                   "checkpoint_dir": str(tmp_path / "g1"),
+                                   "spool": fl["spool"],
+                                   "generation": "genA"})
+    assert ad["op"] == "adopted" and ad["owned"] == [0, 1]
+    assert ad["rows"] >= 1 and ad["replayed"] == 1
+    assert gw0.owns_user(1) and gw0.owns_user(3)
+    ids = np.array([1, 3, 5], np.int64)
+    assert s0.owns(ids).all()
+    for want, have in zip(s1.read(ids), s0.read(ids)):
+        np.testing.assert_array_equal(want, have)
+    # The replayed pending update incorporates on the survivor's clock.
+    assert any(p.user == 7 for p in e0.pending)
+    snap = e0.registry.snapshot()["counters"]
+    assert snap["gateway_adoptions"] == 1
+
+
+# ------------------------------------------------------------- socket fleet
+
+def test_two_gateway_fleet_inprocess(tmp_path):
+    """Full wire path: two run_gateway threads (once=True) behind one
+    port-file base, driven by the partitioning GatewayClient — including
+    a deliberately misrouted frame whose redirect the client follows."""
+    pf = str(tmp_path / "port")
+    threads = [
+        threading.Thread(target=run_gateway, kwargs=dict(
+            cfg=_small_cfg(), gateway_index=g, num_gateways=2,
+            port_file=pf, once=True,
+            history_path=str(tmp_path / "hist.jsonl"), verbose=False))
+        for g in (0, 1)]
+    for th in threads:
+        th.start()
+    try:
+        with GatewayClient(port_file=pf, num_gateways=2, seed=0) as c:
+            w = c.hello(0)
+            assert w["gateway"] == 0 and w["num_gateways"] == 2
+            events = [[k % 10, 0.05 * k, 0.0] for k in range(40)]
+            counts = c.send_events(events)
+            assert sum(counts.values()) == 40
+            # Misroute on purpose: user 1 sent to gateway 0 redirects,
+            # the client follows to the owner and gets a real ack.
+            resp = c.request(c.stamped({"op": "update", "user": 1,
+                                        "t": 5.0}), gateway=0)
+            assert resp["op"] == "ack"
+            assert c.stats["redirected"] >= 1
+            drains = c.request_each({"op": "drain"})
+            assert all(r is not None and r["op"] == "drained"
+                       for r in drains.values())
+            incorporated = sum(r["incorporated"]
+                               for r in drains.values())
+            assert incorporated == 41    # 40 batched + 1 redirected
+    finally:
+        for th in threads:
+            th.join(timeout=60)
+    assert not any(th.is_alive() for th in threads)
+    for g in (0, 1):
+        assert os.path.exists(f"{tmp_path / 'hist.jsonl'}.g{g}")
+
+
+def test_probe_fleet_reports_liveness(tmp_path):
+    pf = str(tmp_path / "port")
+    th = threading.Thread(target=run_gateway, kwargs=dict(
+        cfg=_small_cfg(), gateway_index=0, num_gateways=1, port_file=pf,
+        once=True, verbose=False))
+    th.start()
+    try:
+        rows = probe_fleet(pf, 1, timeout=30)
+    finally:
+        th.join(timeout=60)
+    assert rows[0]["ok"] and rows[0]["gateway_reported"] == 0
+    assert rows[0]["backlog"] == 0
+    # A fleet that never came up: rows report errors, nothing raises.
+    dead = probe_fleet(str(tmp_path / "nope"), 2, timeout=0.2)
+    assert len(dead) == 2
+    assert not any(r["ok"] for r in dead)
+    assert all("error" in r for r in dead)
+
+
+# -------------------------------------------------- chaos rows (full tier)
+
+@pytest.mark.slow
+def test_chaos_mp_gateway_kill_row(tmp_path):
+    """SIGKILL one gateway of a supervised fleet under driven load: zero
+    lost acked updates, duplicates absorbed, SLO burn inside budget."""
+    from fedtpu.resilience.chaos import run_scenario
+    row = run_scenario("mp_gateway_kill", str(tmp_path), {}, 0, 0,
+                       platform="cpu", timeout=570)
+    assert row["ok"], row
+    assert row["gang_restarts"] >= 1
+    assert row["duplicate_drops"] >= 1
+    assert row["lost_acked"] == 0
+
+
+@pytest.mark.slow
+def test_chaos_mp_store_shard_kill_row(tmp_path):
+    """Shard death mid-round: the survivor absorbs ownership via
+    flush/adopt and the degraded fleet's history is bitwise
+    reproducible."""
+    from fedtpu.resilience.chaos import run_scenario
+    row = run_scenario("mp_store_shard_kill", str(tmp_path), {}, 0, 0,
+                       platform="cpu", timeout=570)
+    assert row["ok"], row
+    assert row["history_match"] is True

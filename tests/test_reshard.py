@@ -133,6 +133,39 @@ def test_reshard_state_rejects_bad_row_map():
         reshard_state(state, dst_mesh=mesh, dst_clients=4, row_map=[0, 1])
 
 
+def test_reshard_state_remote_rows_fills_non_addressable(tmp_path):
+    """ISSUE 12: the genuinely cross-host row path. Rows the executing
+    process cannot address — here, row-map entries past the 2-client
+    source extent, the absorb-from-a-dead-peer case — are filled by the
+    remote_rows callback (a dead shard's exported arrays) instead of
+    raising, and carried rows stay bitwise."""
+    mesh, state = _mesh_state(2)
+    asked = {}
+
+    def remote(path, missing, row_shape, dtype):
+        asked[path] = list(missing)
+        base = np.asarray(missing, np.int64).reshape(-1, *([1] *
+                                                           len(row_shape)))
+        return (100.0 + base).astype(dtype) * np.ones(row_shape, dtype)
+
+    new, steps = reshard_state(
+        state, dst_mesh=make_mesh(None, 4), dst_clients=4,
+        row_map=[0, 1, 2, 3], remote_rows=remote)
+    out = np.asarray(new["params"]["w"])
+    np.testing.assert_array_equal(out[:2],
+                                  np.asarray(state["params"]["w"]))
+    np.testing.assert_array_equal(out[2], np.full(3, 102.0, np.float32))
+    np.testing.assert_array_equal(out[3], np.full(3, 103.0, np.float32))
+    assert asked["['params']['w']"] == [2, 3]
+    client = [s for s in steps if s.kind == "client"][0]
+    assert client.rows == 4 and client.join_rows == 0
+    # A wrong-shape fill is a hard error, not silent corruption.
+    with pytest.raises(ValueError, match="remote_rows returned shape"):
+        reshard_state(state, dst_mesh=make_mesh(None, 4), dst_clients=4,
+                      row_map=[0, 1, 2, 3],
+                      remote_rows=lambda p, m, s, d: np.zeros((1, 1), d))
+
+
 # ------------------------------------------------------------- controller
 
 
