@@ -1,0 +1,164 @@
+"""Price of the poisoning defenses (docs/robustness.md) on the hot path.
+
+Three rows, each a clean-traffic overhead question — what does arming a
+defense cost when nobody is attacking:
+
+    screen_tick:    steady-state wall per driven async tick with the
+                    in-jit screen (norm ring + cosine test) armed vs
+                    off, same arrivals, same model. The screen adds one
+                    norm + one dot per client slot plus the rolling
+                    median ring update.
+    cohort_robust:  wall per cohort round for robust='median' and
+                    'trimmed_mean' vs the plain psum mean, end-to-end
+                    through run_experiment (the sort network per
+                    coordinate is the cost).
+    defense_sim:    wall-clock of the pinned golden campaign
+                    (fedtpu.robust.defense_sim) plus its containment
+                    summary — the price of the tier-1 gate itself.
+
+Run: ``python benchmarks/robust_bench.py`` (~2 min on the CPU box).
+Emits bench.py-style output: detail lines on stderr, one full JSON blob
+last on stdout (and to --out); raw committed rows live in
+``benchmarks/robust_bench.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+
+def _screen_tick_row(ticks, warmup):
+    import jax
+
+    from fedtpu.config import ModelConfig, OptimConfig, ShardConfig
+    from fedtpu.data.sharding import pack_clients
+    from fedtpu.data.tabular import synthetic_income_like
+    from fedtpu.models import build_model
+    from fedtpu.ops import build_optimizer
+    from fedtpu.parallel import async_fed, client_sharding, make_mesh
+
+    C = 8
+    x, y = synthetic_income_like(512, 6, 2, seed=0)
+    packed = pack_clients(x, y, ShardConfig(num_clients=C, shuffle=False))
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(64, 32)))
+    tx = build_optimizer(OptimConfig())
+    mesh = make_mesh(num_clients=C)
+    batch = {k: jax.device_put(v, client_sharding(mesh)) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    arr = np.ones((1, C), np.float32)
+
+    def timed(screen):
+        state = async_fed.init_async_state(
+            jax.random.key(0), mesh, C, init_fn, tx, same_init=True,
+            screen_window=64 if screen else 0)
+        step = async_fed.build_async_round_fn(
+            mesh, apply_fn, tx, 2, driven=True, screen=screen)
+        for _ in range(warmup):  # compile + screen warmup out of the window
+            state, m = step(state, batch, arr)
+        jax.block_until_ready(m["staleness"])
+        t0 = time.perf_counter()
+        for _ in range(ticks):
+            state, m = step(state, batch, arr)
+        # Completion proof: host value dependent on the full chain.
+        screened = (float(np.asarray(m["screened"]).sum())
+                    if screen else 0.0)
+        jax.block_until_ready(m["staleness"])
+        wall = time.perf_counter() - t0
+        return wall / ticks, screened
+
+    off_s, _ = timed(False)
+    on_s, screened = timed(True)
+    assert screened == 0.0, "screen fired on clean traffic"
+    return {"row": "screen_tick", "clients": C, "ticks": ticks,
+            "screen_window": 64,
+            "off_s_per_tick": off_s, "on_s_per_tick": on_s,
+            "overhead_pct": (on_s - off_s) / off_s * 100.0,
+            "false_positives": int(screened)}
+
+
+def _cohort_robust_row(rounds, reps):
+    from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                               RunConfig, ShardConfig)
+    from fedtpu.orchestration.loop import run_experiment
+
+    def wall(robust):
+        best = float("inf")
+        acc = None
+        for _ in range(reps):
+            cfg = ExperimentConfig(
+                data=DataConfig(csv_path=None, synthetic_rows=512),
+                shard=ShardConfig(num_clients=32),
+                fed=FedConfig(rounds=rounds, weighting="uniform",
+                              cohort_size=8, robust_aggregation=robust),
+                run=RunConfig(),
+            )
+            t0 = time.perf_counter()
+            res = run_experiment(cfg, verbose=False)
+            best = min(best, time.perf_counter() - t0)
+            acc = float(res.pooled_metrics["accuracy"][-1])
+        return best / rounds, acc
+
+    none_s, none_acc = wall("none")
+    med_s, med_acc = wall("median")
+    trim_s, trim_acc = wall("trimmed_mean")
+    return {"row": "cohort_robust", "clients": 32, "cohort": 8,
+            "rounds": rounds,
+            "mean_s_per_round": none_s, "median_s_per_round": med_s,
+            "trimmed_mean_s_per_round": trim_s,
+            "median_overhead_pct": (med_s - none_s) / none_s * 100.0,
+            "trimmed_overhead_pct": (trim_s - none_s) / none_s * 100.0,
+            "accuracy": {"mean": none_acc, "median": med_acc,
+                         "trimmed_mean": trim_acc}}
+
+
+def _defense_sim_row():
+    from fedtpu.robust.defense_sim import simulate
+    t0 = time.perf_counter()
+    out = simulate()
+    wall = time.perf_counter() - t0
+    s = out["summary"]
+    return {"row": "defense_sim", "wall_s": wall,
+            "arrivals": s["arrivals"], "ticks": s["ticks"],
+            "decision_lines": len(out["lines"]),
+            "attackers": len(s["attackers"]),
+            "quarantined_attackers": len(s["quarantined_attackers"]),
+            "quarantined_honest": len(s["quarantined_honest"]),
+            "eval_accuracy": s["eval_accuracy"]}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ticks", type=int, default=60,
+                    help="timed driven async ticks per screen branch")
+    ap.add_argument("--rounds", type=int, default=20,
+                    help="cohort rounds per robust rule")
+    ap.add_argument("--reps", type=int, default=2,
+                    help="cohort wall-clock reps; best-of is reported")
+    ap.add_argument("--out", default="BENCH_ROBUST.json")
+    args = ap.parse_args(argv)
+
+    rows = []
+    for fn, kw in ((_screen_tick_row, dict(ticks=args.ticks, warmup=12)),
+                   (_cohort_robust_row, dict(rounds=args.rounds,
+                                             reps=args.reps)),
+                   (_defense_sim_row, {})):
+        row = fn(**kw)
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr)
+
+    result = {"rows": rows}
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -440,6 +440,31 @@ def _add_serving_flags(p: argparse.ArgumentParser) -> None:
                    default=16,
                    help="versions behind at which an update is "
                         "rejected (default 16)")
+    p.add_argument("--screen", action="store_true",
+                   help="enable streaming update screening: non-finite "
+                        "guard, norm-vs-rolling-median, and cosine "
+                        "tests reject poisoned arrivals in-jit before "
+                        "the K-buffer (docs/robustness.md)")
+    p.add_argument("--screen-norm-mult", type=_positive_float,
+                   default=4.0,
+                   help="screen when an update's norm exceeds this "
+                        "multiple of the rolling median of accepted "
+                        "norms (default 4)")
+    p.add_argument("--screen-cos-min", type=float, default=-0.2,
+                   help="screen when cosine against the server "
+                        "direction falls below this (in [-1, 1); "
+                        "default -0.2)")
+    p.add_argument("--screen-warmup", type=_positive_int, default=8,
+                   help="accepted-norm samples before the norm test "
+                        "arms (default 8)")
+    p.add_argument("--screen-clip-norm", type=_nonnegative_float,
+                   default=0.0,
+                   help="also clip accepted update norms to this bound "
+                        "(0 = off)")
+    p.add_argument("--quarantine-strikes", type=_positive_int,
+                   default=3,
+                   help="screened strikes before a user id is "
+                        "quarantined (default 3)")
     p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
                    help="drain-time (and periodic) serving "
                         "checkpoints land here; required for "
@@ -765,6 +790,12 @@ def build_parser() -> argparse.ArgumentParser:
                               "simulation and compare its decision "
                               "sequence bitwise against this golden "
                               "JSONL, folded into the exit code")
+    check_p.add_argument("--defense-sim", default=None, metavar="GOLDEN",
+                         help="also replay the pinned poisoning-defense "
+                              "simulation (screening engine over a seeded "
+                              "adversarial trace) and compare its decision "
+                              "log bitwise against this golden JSONL, "
+                              "folded into the exit code")
     check_p.add_argument("--gateway-probe", default=None,
                          metavar="PORT_FILE_BASE",
                          help="also probe a live gateway fleet's health "
@@ -983,7 +1014,8 @@ def build_parser() -> argparse.ArgumentParser:
                                  "against a running 'fedtpu serve' "
                                  "(docs/serving.md)")
     load_p.add_argument("trace", help="arrival-trace JSONL path "
-                                      "(fedtpu.serving.traces schema v1)")
+                                      "(fedtpu.serving.traces schema "
+                                      "v1/v2)")
     load_p.add_argument("--synthesize", action="store_true",
                         help="first write a fresh synthetic trace to the "
                              "given path (--users/--arrivals/--horizon/"
@@ -999,6 +1031,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "--synthesize (default 60)")
     load_p.add_argument("--trace-seed", type=_nonnegative_int, default=0,
                         help="synthesizer seed (default 0)")
+    load_p.add_argument("--poison-frac", type=_nonnegative_float,
+                        default=0.0,
+                        help="for --synthesize: fraction of users that "
+                             "are seeded attackers (schema v2 adversarial "
+                             "trace; 0 = honest v1 trace, the default)")
+    load_p.add_argument("--poison-scale", type=_positive_float,
+                        default=10.0,
+                        help="sign-flip amplification the attackers "
+                             "submit (default 10)")
     load_p.add_argument("--host", default="127.0.0.1")
     load_p.add_argument("--port", type=_nonnegative_int, default=None,
                         help="server port (or use --port-file)")
@@ -1234,12 +1275,17 @@ def main(argv=None) -> int:
         if args.synthesize:
             header, t, user, lat = synthesize_trace(
                 users=args.users, arrivals=args.arrivals,
-                horizon_s=args.horizon, seed=args.trace_seed)
+                horizon_s=args.horizon, seed=args.trace_seed,
+                poison_frac=args.poison_frac,
+                poison_scale=args.poison_scale)
             write_trace(args.trace, header, t, user, lat)
             if not args.quiet:
+                tag = (f" ({args.poison_frac:.0%} poisoned, scale "
+                       f"{args.poison_scale:g})" if args.poison_frac > 0
+                       else "")
                 print(f"synthesized {args.arrivals} arrivals / "
-                      f"{args.users} users over {args.horizon}s "
-                      f"-> {args.trace}")
+                      f"{args.users} users over {args.horizon}s"
+                      f"{tag} -> {args.trace}")
         summary = run_loadgen(args.trace, host=args.host, port=args.port,
                               port_file=args.port_file, batch=args.batch,
                               max_events=args.max_events,
@@ -1450,6 +1496,24 @@ def main(argv=None) -> int:
                 "golden": args.autoscale_sim,
                 "control_ticks": sim["summary"]["control_ticks"]}
             report["ok"] = report["ok"] and cmp["ok"]
+        if args.defense_sim:
+            # Fold the pinned poisoning-defense simulation into the
+            # check: the screen/quarantine decision log must match the
+            # committed golden bitwise — defense drift (screen math,
+            # thresholds, trace synthesis) fails the gate like a retrace.
+            from fedtpu.robust.defense_sim import (compare_decisions as
+                                                   _cmp_defense)
+            from fedtpu.robust.defense_sim import simulate as _sim_defense
+            sim = _sim_defense()
+            cmp = _cmp_defense(sim["lines"], args.defense_sim)
+            report["defense_sim"] = {
+                "ok": cmp["ok"], "reason": cmp["reason"],
+                "golden": args.defense_sim,
+                "screened": sim["summary"]["screened"],
+                "quarantined": sim["summary"]["quarantined"],
+                "quarantined_honest": sim["summary"]["quarantined_honest"],
+                "eval_accuracy": sim["summary"]["eval_accuracy"]}
+            report["ok"] = report["ok"] and cmp["ok"]
         if args.gateway_probe:
             # Fold a live fleet health probe into the check: every member
             # must answer a stats round-trip on its derived port file.
@@ -1473,6 +1537,12 @@ def main(argv=None) -> int:
             if "autoscale_sim" in report:
                 a = report["autoscale_sim"]
                 print(f"autoscale-sim: ok={a['ok']} ({a['reason']})")
+            if "defense_sim" in report:
+                d = report["defense_sim"]
+                print(f"defense-sim: ok={d['ok']} ({d['reason']}) "
+                      f"quarantined={d['quarantined']} "
+                      f"honest={d['quarantined_honest']} "
+                      f"accuracy={d['eval_accuracy']:.4f}")
             if "gateway_probe" in report:
                 for r in report["gateway_probe"]:
                     state = ("up" if r["ok"]
@@ -1532,7 +1602,13 @@ def main(argv=None) -> int:
             rate_limit=args.rate_limit,
             rate_burst=args.rate_burst, max_pending=args.max_pending,
             stale_deprioritize=args.stale_deprioritize,
-            stale_reject=args.stale_reject, seed=args.seed)
+            stale_reject=args.stale_reject, seed=args.seed,
+            screen=args.screen,
+            screen_norm_mult=args.screen_norm_mult,
+            screen_cos_min=args.screen_cos_min,
+            screen_warmup=args.screen_warmup,
+            screen_clip_norm=args.screen_clip_norm,
+            quarantine_strikes=args.quarantine_strikes)
         try:
             summary = run_server(
                 scfg, events=args.events,
@@ -1569,7 +1645,13 @@ def main(argv=None) -> int:
             rate_limit=args.rate_limit,
             rate_burst=args.rate_burst, max_pending=args.max_pending,
             stale_deprioritize=args.stale_deprioritize,
-            stale_reject=args.stale_reject, seed=args.seed)
+            stale_reject=args.stale_reject, seed=args.seed,
+            screen=args.screen,
+            screen_norm_mult=args.screen_norm_mult,
+            screen_cos_min=args.screen_cos_min,
+            screen_warmup=args.screen_warmup,
+            screen_clip_norm=args.screen_clip_norm,
+            quarantine_strikes=args.quarantine_strikes)
         try:
             summary = run_gateway(
                 scfg, gateway_index=args.gateway_index,

@@ -106,6 +106,10 @@ class CohortSampler:
         self.k = int(cohort_size)
         self.policy = policy
         self.seed = int(seed)
+        # Quarantined ids (fedtpu.robust): refuse() removes them from
+        # every future draw. Empty set = the exact pre-defense sampling
+        # code path, bitwise (the parity tests pin it).
+        self.quarantined: set = set()
         if policy == "weighted":
             if weights is None:
                 raise ValueError("weighted sampling needs a weights array")
@@ -127,27 +131,54 @@ class CohortSampler:
                     f"outside the population [0, {self.total})")
             self.trace_users = tu
 
+    def refuse(self, ids) -> None:
+        """Quarantine ``ids`` (fedtpu.robust): no future sample() ever
+        includes them. Raises if the surviving population cannot fill
+        one cohort — a defense that quarantines the training population
+        away must fail loudly, not sample ghosts."""
+        self.quarantined |= {int(i) for i in np.atleast_1d(
+            np.asarray(ids, np.int64))}
+        if self.total - len(self.quarantined) < self.k:
+            raise ValueError(
+                f"{len(self.quarantined)} quarantined ids leave fewer "
+                f"than cohort_size={self.k} of {self.total} clients — "
+                "population exhausted (raise the population or review "
+                "the quarantine thresholds, docs/robustness.md)")
+
     def sample(self, round0: int, num_cohorts: int = 1) -> np.ndarray:
         """``(num_cohorts, cohort_size)`` int64 ids, distinct across the
-        WHOLE chunk (see the module docstring's disjointness contract)."""
+        WHOLE chunk (see the module docstring's disjointness contract).
+        Quarantined ids never appear."""
         need = num_cohorts * self.k
-        if need > self.total:
+        q = self.quarantined
+        if need > self.total - len(q):
             raise ValueError(
                 f"{num_cohorts} disjoint cohorts of {self.k} need "
-                f"{need} distinct clients, population is {self.total}")
+                f"{need} distinct clients, population is {self.total}"
+                + (f" minus {len(q)} quarantined" if q else ""))
         if self.policy == "trace":
             ids = self._from_trace(round0, need)
         elif self.policy == "weighted":
             rng = np.random.default_rng((self.seed, round0))
-            ids = rng.choice(self.total, size=need, replace=False, p=self.p)
-        elif need == self.total:
+            p = self.p
+            if q:
+                p = p.copy()
+                p[sorted(q)] = 0.0
+                if p.sum() <= 0:
+                    raise ValueError("quarantine removed every positively "
+                                     "weighted client")
+                p = p / p.sum()
+            ids = rng.choice(self.total, size=need, replace=False, p=p)
+        elif need == self.total and not q:
             # Full participation: identity order, no draw — the ordering
             # the bitwise vmap-parity contract pins.
             ids = np.arange(self.total, dtype=np.int64)
         else:
             rng = np.random.default_rng((self.seed, round0))
-            if need * 8 >= self.total:
-                ids = rng.permutation(self.total)[:need]
+            if need * 8 >= self.total - len(q):
+                perm = rng.permutation(self.total)
+                ids = np.array([c for c in perm if c not in q][:need],
+                               np.int64)
             else:
                 # Rejection sampling: O(need) for need << total — a
                 # permutation would allocate the whole population.
@@ -156,7 +187,7 @@ class CohortSampler:
                 while len(out) < need:
                     for c in rng.integers(0, self.total,
                                           size=2 * (need - len(out))):
-                        if c not in seen:
+                        if c not in seen and c not in q:
                             seen.add(int(c))
                             out.append(int(c))
                             if len(out) == need:
@@ -171,15 +202,15 @@ class CohortSampler:
         out = []
         for i in range(2 * tu.size):
             u = int(tu[(start + i) % tu.size])
-            if u not in seen:
+            if u not in seen and u not in self.quarantined:
                 seen.add(u)
                 out.append(u)
                 if len(out) == need:
                     return np.array(out, np.int64)
         raise ValueError(
-            f"trace holds only {len(seen)} distinct users, cohort chunk "
-            f"needs {need} — shrink cohort_size/rounds_per_step or widen "
-            "the trace")
+            f"trace holds only {len(seen)} distinct users (quarantined "
+            f"excluded), cohort chunk needs {need} — shrink cohort_size/"
+            "rounds_per_step or widen the trace")
 
 
 def build_cohort_round_fn(mesh, apply_fn: Callable, tx, num_classes: int,
@@ -187,7 +218,9 @@ def build_cohort_round_fn(mesh, apply_fn: Callable, tx, num_classes: int,
                           cohorts_per_step: int = 1,
                           aggregation: str = "psum",
                           local_steps: int = 1,
-                          prox_mu: float = 0.0) -> Callable:
+                          prox_mu: float = 0.0,
+                          robust: str = "none",
+                          trim_ratio: float = 0.1) -> Callable:
     """Compile the scan-over-cohorts chunk. Returns ``step(state, xs) ->
     (state, out)`` where ``state = {params (K,...), round}`` carries the
     global between cohorts (every slot identical after a round — the
@@ -200,8 +233,35 @@ def build_cohort_round_fn(mesh, apply_fn: Callable, tx, num_classes: int,
 
     The per-cohort body is the plain-averaging vmap round, op for op —
     that identity is the parity contract, so this program supports
-    exactly what that path supports (no DP / robust / compress /
-    scaffold; ``run_cohort_experiment`` rejects those loudly)."""
+    exactly what that path supports (no DP / compress / scaffold;
+    ``run_cohort_experiment`` rejects those loudly).
+
+    ``robust`` in ``('median', 'trimmed_mean')`` replaces the weighted
+    mean with MASK-AWARE coordinate order statistics over the cohort
+    block (fedtpu.robust; docs/robustness.md): dataless slots pad to
+    +inf, the order statistic runs over the participating count only,
+    and a fully dataless cohort carries the global unchanged — the same
+    semantics the vmap path's sampling-aware rules use. Requires
+    uniform weighting and the psum backend (an all_gather replaces the
+    tensordot reduction; the audit goldens pin the new schedule)."""
+    if robust not in ("none", "median", "trimmed_mean"):
+        raise ValueError(
+            f"cohort robust must be 'none', 'median' or 'trimmed_mean', "
+            f"got {robust!r} (krum/geometric_median score whole updates "
+            "and stay vmap-engine-only)")
+    if robust != "none":
+        if weighting != "uniform":
+            raise ValueError("cohort robust aggregation is unweighted — "
+                             "median/trimmed_mean of weighted updates is "
+                             "not the weighted robust location; use "
+                             "weighting='uniform'")
+        if aggregation != "psum":
+            raise ValueError("cohort robust aggregation needs the plain "
+                             "psum backend (order statistics gather the "
+                             "cohort block; the ring backend reduces)")
+        if not 0.0 <= trim_ratio < 0.5:
+            raise ValueError(f"trim_ratio must be in [0, 0.5), got "
+                             f"{trim_ratio}")
     local_train = make_local_train_step(apply_fn, tx,
                                         local_steps=local_steps,
                                         prox_mu=prox_mu)
@@ -232,7 +292,48 @@ def build_cohort_round_fn(mesh, apply_fn: Callable, tx, num_classes: int,
                 # like the vmap path's zero-participant round.
                 return jnp.where(total_w > 0, bcast_global(glob, p), p)
 
-            new_params = jax.tree.map(avg, trained)
+            if robust != "none":
+                # Mask-aware order statistics over the WHOLE cohort
+                # block: gather the K slot params, pad dataless slots to
+                # +inf so they sort past every live value, and take the
+                # statistic over the participating count (traced).
+                part = (n > 0).astype(jnp.float32)
+                part_all = jax.lax.all_gather(
+                    part, CLIENTS_AXIS).reshape(-1)       # (K,)
+                n_act = part_all.sum()
+                n_i = n_act.astype(jnp.int32)
+                k_t = jnp.round(trim_ratio * n_act).astype(jnp.int32)
+
+                def ragg(p):
+                    allc = jax.lax.all_gather(p.astype(jnp.float32),
+                                              CLIENTS_AXIS)
+                    allc = allc.reshape((-1,) + p.shape[1:])   # (K, ...)
+                    live = part_all.reshape(
+                        (-1,) + (1,) * (allc.ndim - 1))
+                    srt = jnp.sort(jnp.where(live > 0, allc, jnp.inf),
+                                   axis=0)
+                    if robust == "median":
+                        lo = jax.lax.dynamic_index_in_dim(
+                            srt, jnp.maximum((n_i - 1) // 2, 0),
+                            keepdims=False)
+                        hi = jax.lax.dynamic_index_in_dim(
+                            srt, jnp.maximum(n_i // 2, 0),
+                            keepdims=False)
+                        glob = 0.5 * (lo + hi)
+                    else:
+                        j = jax.lax.broadcasted_iota(jnp.int32,
+                                                     srt.shape, 0)
+                        keep = (j >= k_t) & (j < n_i - k_t)
+                        denom = jnp.maximum(
+                            n_act - 2.0 * k_t.astype(jnp.float32), 1.0)
+                        glob = jnp.where(keep, srt, 0.0).sum(
+                            axis=0) / denom
+                    return jnp.where(n_act > 0,
+                                     bcast_global(glob, p), p)
+
+                new_params = jax.tree.map(ragg, trained)
+            else:
+                new_params = jax.tree.map(avg, trained)
             pooled = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)
             return (new_params, r + 1), (new_params, new_opt, loss, conf,
                                          pooled)
@@ -285,6 +386,7 @@ class CohortScheduler:
                  weighting: str = "data_size", aggregation: str = "psum",
                  local_steps: int = 1, prox_mu: float = 0.0,
                  cohorts_per_step: int = 1, prefetch: bool = True,
+                 robust: str = "none", trim_ratio: float = 0.1,
                  registry=None, tracer=None):
         self.mesh = mesh
         self.store = store
@@ -299,7 +401,13 @@ class CohortScheduler:
         self.step_fn = build_cohort_round_fn(
             mesh, apply_fn, tx, num_classes, weighting=weighting,
             cohorts_per_step=self.s, aggregation=aggregation,
-            local_steps=local_steps, prox_mu=prox_mu)
+            local_steps=local_steps, prox_mu=prox_mu,
+            robust=robust, trim_ratio=trim_ratio)
+        # Durable quarantine: records flagged in the store (by a serving
+        # engine sharing it, or a prior run) never enter a cohort.
+        flagged = store.quarantined_ids()
+        if flagged.size:
+            sampler.refuse(flagged)
         # The SAME per-client key table the vmap path's
         # init_federated_state derives — lazy store init must hand client
         # i the identical init the vmap engine would have (the bitwise
@@ -520,10 +628,25 @@ def _validate_cohort_config(cfg) -> None:
                          "only (no server_opt / DP): the delta path's "
                          "replicated server state is not yet streamed "
                          "through the client store")
-    if fed.robust_aggregation != "none" or fed.byzantine_clients:
-        raise ValueError("cohort mode does not support robust "
-                         "aggregation (those rules assume the full "
-                         "population each round)")
+    if fed.robust_aggregation not in ("none", "median", "trimmed_mean"):
+        raise ValueError(
+            f"cohort mode supports robust_aggregation 'median'/"
+            f"'trimmed_mean' only (mask-aware order statistics over the "
+            f"cohort block); {fed.robust_aggregation!r} scores whole "
+            "updates and needs the vmap engine's full population")
+    if fed.robust_aggregation != "none" and fed.weighting != "uniform":
+        raise ValueError("cohort robust aggregation is unweighted — set "
+                         "weighting='uniform' (the median of weighted "
+                         "updates is not the weighted robust location)")
+    if fed.robust_aggregation != "none" and fed.aggregation != "psum":
+        raise ValueError("cohort robust aggregation needs the plain psum "
+                         "backend (order statistics gather the cohort "
+                         "block)")
+    if fed.byzantine_clients:
+        raise ValueError("cohort mode does not inject synthetic byzantine "
+                         "clients (byzantine_clients) — adversarial load "
+                         "comes from poisoned serving traces "
+                         "(serving/traces.py --poison-frac)")
     if fed.compress != "none":
         raise ValueError("cohort mode does not support compressed "
                          "exchange")
@@ -639,6 +762,7 @@ def run_cohort_experiment(cfg, dataset=None, verbose: bool = True,
         same_init=cfg.fed.same_init, weighting=cfg.fed.weighting,
         aggregation=cfg.fed.aggregation, local_steps=cfg.fed.local_steps,
         prox_mu=cfg.fed.prox_mu, cohorts_per_step=s,
+        robust=cfg.fed.robust_aggregation, trim_ratio=cfg.fed.trim_ratio,
         registry=registry, tracer=tracer)
 
     history = {k2: [] for k2 in METRIC_NAMES}

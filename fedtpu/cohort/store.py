@@ -9,8 +9,18 @@ small per-record header:
     offset 0   version       uint64   0 = never initialized
     offset 8   participation uint64   rounds this client trained in
     offset 16  rng_key       2xuint32 per-client PRNG key data
-    offset 24  leaf 0 bytes (raw, exact dtype), 8-byte padded
+    offset 24  strikes       uint32   defense screen strike count
+    offset 28  flags         uint32   bit 0 = quarantined
+    offset 32  leaf 0 bytes (raw, exact dtype), 8-byte padded
                leaf 1 bytes ...
+
+The strikes/flags pair is the reputation field (fedtpu.robust;
+docs/robustness.md): the serving engine's screen accrues strikes, the
+quarantine bit refuses the client everywhere ids are drawn
+(CohortSampler, the serving offer path). Reputation writes ride the
+normal versioned-record machinery — version bump, touched-row
+checkpointing, the flush/adopt digest fence — bitwise, because the
+digest hashes raw record bytes and the header IS record bytes.
 
 Raw-byte records round-trip every dtype bitwise (f32 params, i32 Adam
 counts, i32 pull ticks) — the store is a persistence layer, never a
@@ -60,10 +70,14 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-HEADER_BYTES = 24
+HEADER_BYTES = 32
 _VER_OFF = 0
 _PART_OFF = 8
 _KEY_OFF = 16
+_STRIKE_OFF = 24
+_FLAGS_OFF = 28
+
+FLAG_QUARANTINED = np.uint32(1)
 
 BACKENDS = ("memory", "mmap")
 
@@ -233,6 +247,55 @@ class ClientStateStore:
         raw = np.ascontiguousarray(
             self._fetch(ids)[:, _KEY_OFF:_KEY_OFF + 8])
         return raw.view(np.uint32).reshape(-1, 2)
+
+    def reputation(self, ids) -> Tuple[np.ndarray, np.ndarray]:
+        """``(strikes, quarantined)`` for ``ids``: (K,) uint32 strike
+        counts and (K,) bool quarantine bits. Never-written records
+        read as (0, False) — reputation starts clean."""
+        rows = self._fetch(ids)
+        strikes = np.ascontiguousarray(
+            rows[:, _STRIKE_OFF:_STRIKE_OFF + 4]).view(
+                np.uint32).reshape(-1)
+        flags = np.ascontiguousarray(
+            rows[:, _FLAGS_OFF:_FLAGS_OFF + 4]).view(
+                np.uint32).reshape(-1)
+        return strikes, (flags & FLAG_QUARANTINED) != 0
+
+    def set_reputation(self, ids, strikes, quarantined) -> None:
+        """Write the reputation header fields for distinct ``ids``
+        (leaves untouched) with a version bump, so reputation rides the
+        same touched-row checkpoint/flush/adopt path as records."""
+        ids = np.asarray(ids, np.int64)
+        if len(np.unique(ids)) != ids.size:
+            raise ValueError("set_reputation ids must be distinct "
+                             "within one call")
+        k = ids.size
+        st = np.broadcast_to(np.asarray(strikes, np.uint32), (k,))
+        qr = np.broadcast_to(np.asarray(quarantined, bool), (k,))
+        rows = self._fetch(ids)
+        rows[:, _STRIKE_OFF:_STRIKE_OFF + 4] = \
+            np.ascontiguousarray(st).reshape(k, 1).view(np.uint8)
+        flags = np.ascontiguousarray(
+            rows[:, _FLAGS_OFF:_FLAGS_OFF + 4]).view(
+                np.uint32).reshape(-1)
+        flags = np.where(qr, flags | FLAG_QUARANTINED,
+                         flags & ~FLAG_QUARANTINED).astype(np.uint32)
+        rows[:, _FLAGS_OFF:_FLAGS_OFF + 4] = \
+            np.ascontiguousarray(flags).reshape(k, 1).view(np.uint8)
+        ver = np.ascontiguousarray(
+            rows[:, _VER_OFF:_VER_OFF + 8]).view(np.uint64).reshape(-1)
+        rows[:, _VER_OFF:_VER_OFF + 8] = \
+            (ver + 1).reshape(k, 1).view(np.uint8)
+        self._store(ids, rows)
+
+    def quarantined_ids(self) -> np.ndarray:
+        """Sorted int64 ids of every TOUCHED record whose quarantine
+        bit is set (untouched records are clean by construction)."""
+        ids = np.array(sorted(self._touched), np.int64)
+        if not ids.size:
+            return ids
+        _, quarantined = self.reputation(ids)
+        return ids[quarantined]
 
     # -- records -------------------------------------------------------
     def read(self, ids) -> List[np.ndarray]:

@@ -437,6 +437,18 @@ class ServingConfig:
     # Sliding window (virtual s) for the admission stats the autoscale
     # control plane reads off the `stats` protocol op.
     admission_window_s: float = 10.0
+    # Poisoning defense (fedtpu.robust; docs/robustness.md). screen=True
+    # turns on the in-tick update screen (non-finite guard, norm-vs-
+    # rolling-median, cosine-vs-server-direction); screened updates are
+    # dropped before the K-buffer, counted under `admission_screened`,
+    # and strike their sender — quarantine_strikes strikes quarantines
+    # the user id (persisted in the cohort store when one is attached).
+    screen: bool = False
+    screen_norm_mult: float = 4.0    # norm > mult * rolling median => screen
+    screen_cos_min: float = -0.2     # cosine vs server direction below => screen
+    screen_warmup: int = 8           # accepted ticks before norm screen arms
+    screen_clip_norm: float = 0.0    # L2 clip on accepted updates; 0 = off
+    quarantine_strikes: int = 3      # screened strikes until quarantine
 
 
 @dataclasses.dataclass(frozen=True)

@@ -86,12 +86,16 @@ def record_tick_telemetry(registry, tracer, tick: int, staleness) -> None:
 def init_async_state(key: jax.Array, mesh, num_clients: int,
                      init_fn: Callable, tx: optax.GradientTransformation,
                      same_init: bool = True,
-                     buffer_size: int = 0) -> dict:
+                     buffer_size: int = 0,
+                     screen_window: int = 0) -> dict:
     """Per-client state + anchors. Every client starts having just pulled
     the shared initial global (the uniform mean of the inits), tick 0.
     ``buffer_size >= 2`` adds the FedBuff server buffer
     (``buf_delta``/``buf_count``, replicated, empty) so it persists across
-    compiled calls and checkpoints."""
+    compiled calls and checkpoints. ``screen_window >= 1`` adds the
+    defense screen's rolling norm ring (``screen_norms``/``screen_count``,
+    replicated, empty) — required by ``build_async_round_fn(...,
+    screen=True)`` so the rolling median survives calls and checkpoints."""
     params = jax.vmap(init_fn)(client_init_keys(key, num_clients, same_init))
     g0 = jax.tree.map(lambda p: p.mean(axis=0), params)
     anchors = jax.tree.map(
@@ -113,6 +117,10 @@ def init_async_state(key: jax.Array, mesh, num_clients: int,
                     jnp.zeros(gl.shape, jnp.float32), rep), g0),
             "buf_count": safe_put(jnp.zeros((), jnp.float32), rep),
         }
+    if screen_window >= 1:
+        extra["screen_norms"] = safe_put(
+            jnp.zeros((screen_window,), jnp.float32), rep)
+        extra["screen_count"] = safe_put(jnp.zeros((), jnp.int32), rep)
     return {
         **extra,
         # params start equal to the anchors but must be INDEPENDENT
@@ -141,7 +149,13 @@ def build_async_round_fn(mesh, apply_fn: Callable,
                          prox_mu: float = 0.0,
                          buffer_size: int = 0,
                          ticks_per_step: int = 1,
-                         driven: bool = False) -> Callable:
+                         driven: bool = False,
+                         screen: bool = False,
+                         screen_norm_mult: float = 4.0,
+                         screen_cos_min: float = -0.2,
+                         screen_warmup: int = 8,
+                         screen_window: int = 64,
+                         clip_norm: float = 0.0) -> Callable:
     """Compile the async server tick. Returns ``step(state, batch) ->
     (state, metrics)`` over client-sharded batches, like the synchronous
     engines; ``metrics`` additionally carries ``staleness`` — the (R, C)
@@ -174,6 +188,32 @@ def build_async_round_fn(mesh, apply_fn: Callable,
     other knob (staleness discounting, server_lr, the K-buffer) applies
     identically, so trace-driven and synthetic numbers are directly
     comparable.
+
+    In driven mode each arrival entry is a signed WEIGHT, not just a 0/1
+    flag: entry ``w != 0`` means the client completed this tick and its
+    delta enters aggregation scaled by ``w`` (honest arrivals are 1.0; a
+    poisoned arrival carries ``-scale`` — the amplified sign-flip attack
+    of the serving trace synthesizer's ``--poison-frac`` mode, injected
+    through the existing ``tensordot(disc, delta)`` with zero new math).
+    Every arrival/re-pull gate keys on ``w != 0``, so a poisoned client
+    still pulls, trains, and ages like any other.
+
+    ``screen=True`` (driven mode only; docs/robustness.md) inserts the
+    STREAMING UPDATE SCREEN before the K-buffer: each arrival's submitted
+    update ``w * delta`` is scored in-graph — non-finite guard, norm vs a
+    rolling median of accepted norms (``screen_norm_mult`` x, after
+    ``screen_warmup`` accepted ticks), and cosine vs the current server
+    direction (the pending buffer plus this tick's norm-normalized
+    arrival consensus; below ``screen_cos_min`` fails). A screened
+    arrival is treated as if it never arrived: no param/opt update, no
+    buffer fold, no re-pull (its staleness keeps growing), and its flag
+    is surfaced in ``metrics['screened']`` for host-side strike
+    accounting. The rolling-norm ring lives in the state
+    (``init_async_state(..., screen_window=W)``) so screening decisions
+    replay bitwise across checkpoint/restore. ``clip_norm > 0`` adds the
+    FedBuff-side robust rule — per-arrival L2 clipping of the submitted
+    update to ``clip_norm`` before the discounted sum (full-cohort order
+    statistics don't apply to a K-buffer; a screened/clipped mean does).
     DONATES the input state — rebind, clone to keep."""
     if not 0.0 < arrival_rate <= 1.0:
         raise ValueError(f"arrival_rate must be in (0, 1], got "
@@ -185,7 +225,29 @@ def build_async_round_fn(mesh, apply_fn: Callable,
         raise ValueError(f"server_lr must be > 0, got {server_lr}")
     if buffer_size < 0:
         raise ValueError(f"buffer_size must be >= 0, got {buffer_size}")
+    if screen and not driven:
+        raise ValueError("screen=True needs driven=True — the screen "
+                         "scores externally submitted updates; the "
+                         "synthetic Bernoulli completion process has "
+                         "nothing to screen")
+    if screen:
+        if screen_window < 1:
+            raise ValueError(f"screen_window must be >= 1, got "
+                             f"{screen_window}")
+        if not 1 <= screen_warmup <= screen_window:
+            raise ValueError(f"need 1 <= screen_warmup <= screen_window, "
+                             f"got warmup={screen_warmup} "
+                             f"window={screen_window}")
+        if screen_norm_mult <= 0:
+            raise ValueError(f"screen_norm_mult must be > 0, got "
+                             f"{screen_norm_mult}")
+        if not -1.0 <= screen_cos_min < 1.0:
+            raise ValueError(f"screen_cos_min must be in [-1, 1), got "
+                             f"{screen_cos_min}")
+    if clip_norm < 0:
+        raise ValueError(f"clip_norm must be >= 0, got {clip_norm}")
     buffered = buffer_size >= 2
+    need_norms = screen or clip_norm > 0
     # prox_mu's anchor is the params the step starts from — which here is
     # the client's pulled anchor, exactly the FedProx-against-stale-global
     # regularization FedBuff-style systems pair with many local steps.
@@ -195,13 +257,14 @@ def build_async_round_fn(mesh, apply_fn: Callable,
     local_eval = make_local_eval_step(apply_fn, num_classes)
     n_devices = mesh.devices.size
 
-    def tick_body(params, opt_state, anchors, pull, buf, nbuf, x, y, mask,
-                  rnd, arrivals):
+    def tick_body(params, opt_state, anchors, pull, buf, nbuf, ring,
+                  rcount, x, y, mask, rnd, arrivals):
         cb = x.shape[0]
         gidx = jax.lax.axis_index(CLIENTS_AXIS) * cb + jnp.arange(cb)
 
         def scan_tick(carry, arr):
-            params, opt_state, anchors, pull, buf, nbuf, g, r = carry
+            (params, opt_state, anchors, pull, buf, nbuf, ring, rcount,
+             g, r) = carry
 
             def per_client(cond, a, b):
                 return jnp.where(cond.reshape((cb,) + (1,) * (a.ndim - 1)),
@@ -209,7 +272,9 @@ def build_async_round_fn(mesh, apply_fn: Callable,
 
             if driven:
                 # The caller's admission layer decided who completes this
-                # tick; `arr` is that (cb,) slice of the arrival mask.
+                # tick; `arr` is that (cb,) slice of the arrival mask —
+                # SIGNED weights: nonzero means arrived, a negative entry
+                # is the amplified sign-flip poison payload.
                 arrive = arr.astype(jnp.float32)
             elif arrival_rate < 1.0:
                 tick_key = jax.random.fold_in(
@@ -219,20 +284,116 @@ def build_async_round_fn(mesh, apply_fn: Callable,
                 arrive = (u < arrival_rate).astype(jnp.float32)
             else:
                 arrive = jnp.ones((cb,), jnp.float32)
+            arrived = arrive != 0.0
 
             trained, new_opt, loss = jax.vmap(local_train)(
                 anchors, opt_state, x, y, mask)
-            params = jax.tree.map(partial(per_client, arrive > 0),
+
+            eps = 1e-12
+            if need_norms:
+                # The SUBMITTED update is w_i * delta_i — the arrival
+                # weight is part of the submission, so an amplified
+                # sign-flip inflates the norm and inverts the cosine.
+                sq = sum(
+                    jnp.square(tr.astype(jnp.float32)
+                               - an.astype(jnp.float32)).reshape(
+                                   cb, -1).sum(axis=1)
+                    for tr, an in zip(jax.tree.leaves(trained),
+                                      jax.tree.leaves(anchors)))
+                norms = jnp.abs(arrive) * jnp.sqrt(sq)
+            else:
+                norms = jnp.zeros((cb,), jnp.float32)
+            scr = jnp.zeros((cb,), jnp.float32)
+            if screen:
+                finite = jnp.ones((cb,), bool)
+                for tr, an in zip(jax.tree.leaves(trained),
+                                  jax.tree.leaves(anchors)):
+                    d = tr.astype(jnp.float32) - an.astype(jnp.float32)
+                    finite = finite & jnp.isfinite(d).reshape(
+                        cb, -1).all(axis=1)
+                # Server direction: the pending K-buffer plus this tick's
+                # norm-normalized arrival consensus — each arrival votes
+                # ONE unit vector, so magnitude cannot buy direction and
+                # a sub-majority of attackers cannot flip the reference.
+                w_unit = jnp.where(arrived & finite,
+                                   arrive / jnp.maximum(norms, eps), 0.0)
+
+                def dir_leaf(tr, an, b):
+                    d = tr.astype(jnp.float32) - an.astype(jnp.float32)
+                    return b + jax.lax.psum(
+                        jnp.tensordot(w_unit, d, axes=1), CLIENTS_AXIS)
+
+                u = jax.tree.map(dir_leaf, trained, anchors, buf)
+                unorm = jnp.sqrt(sum(jnp.square(l).sum()
+                                     for l in jax.tree.leaves(u)))
+                dot = sum(
+                    jnp.tensordot(
+                        (tr.astype(jnp.float32)
+                         - an.astype(jnp.float32)).reshape(cb, -1),
+                        ul.reshape(-1), axes=1)
+                    for tr, an, ul in zip(jax.tree.leaves(trained),
+                                          jax.tree.leaves(anchors),
+                                          jax.tree.leaves(u)))
+                cosv = arrive * dot / (norms * unorm + eps)
+                # Rolling median of the accepted-norm ring's valid slice.
+                cnt = jnp.minimum(rcount, screen_window)
+                vals = jnp.where(jnp.arange(screen_window) < cnt, ring,
+                                 jnp.inf)
+                srt = jnp.sort(vals)
+                med = 0.5 * (
+                    jax.lax.dynamic_index_in_dim(
+                        srt, jnp.maximum((cnt - 1) // 2, 0),
+                        keepdims=False)
+                    + jax.lax.dynamic_index_in_dim(
+                        srt, jnp.maximum(cnt // 2, 0), keepdims=False))
+                warm = rcount >= screen_warmup
+                n_tick = jax.lax.psum(
+                    arrived.astype(jnp.float32).sum(), CLIENTS_AXIS)
+                # The cosine screen needs a reference that is not the
+                # update's own vote: at least two contributions (pending
+                # buffer count + this tick's arrivals).
+                dir_ok = (nbuf + n_tick) >= 2.0
+                screened = arrived & (
+                    ~finite
+                    | (warm & (norms > screen_norm_mult * med))
+                    | (dir_ok & (unorm > eps)
+                       & (cosv < screen_cos_min)))
+                scr = screened.astype(jnp.float32)
+                arrived = arrived & ~screened
+                arrive = jnp.where(arrived, arrive, 0.0)
+                # Push one scalar per tick: the mean ACCEPTED norm (no
+                # push on all-screened/empty ticks, so attackers cannot
+                # drag the median by being rejected).
+                acc = arrived.astype(jnp.float32)
+                acc_n = jax.lax.psum((acc * norms).sum(), CLIENTS_AXIS)
+                acc_c = jax.lax.psum(acc.sum(), CLIENTS_AXIS)
+                mean_n = acc_n / jnp.maximum(acc_c, 1.0)
+                pos = jnp.mod(rcount, screen_window)
+                ring = jnp.where(acc_c > 0, ring.at[pos].set(mean_n),
+                                 ring)
+                rcount = rcount + (acc_c > 0).astype(jnp.int32)
+
+            # A screened arrival is treated as if it never arrived from
+            # here on: no param/opt adoption, no buffer fold, no re-pull
+            # — its staleness keeps growing, so persistent offenders age
+            # into the admission layer's staleness rejection too.
+            params = jax.tree.map(partial(per_client, arrived),
                                   trained, params)
             opt_state = jax.tree.map(
-                lambda a, b: (per_client(arrive > 0, a, b)
+                lambda a, b: (per_client(arrived, a, b)
                               if getattr(a, "ndim", 0) >= 1
                               and a.shape[:1] == (cb,) else a),
                 new_opt, opt_state)
 
             stale = (r - pull).astype(jnp.float32)
             disc = arrive * (1.0 + stale) ** -staleness_power
-            n_arrived = jax.lax.psum(arrive.sum(), CLIENTS_AXIS)
+            if clip_norm > 0:
+                # Clipped-mean rule: the submitted update's contribution
+                # is L2-clipped to clip_norm before the discounted sum.
+                disc = disc * jnp.minimum(
+                    1.0, clip_norm / jnp.maximum(norms, eps))
+            n_arrived = jax.lax.psum(arrived.astype(jnp.float32).sum(),
+                                     CLIENTS_AXIS)
 
             def summed(tr, an):
                 delta = tr.astype(jnp.float32) - an.astype(jnp.float32)
@@ -260,10 +421,10 @@ def build_async_round_fn(mesh, apply_fn: Callable,
             nbuf = jnp.where(apply, 0.0, nbuf)
             # Arrivals re-pull the fresh global; absentees keep aging.
             anchors = jax.tree.map(
-                lambda gl, an: per_client(arrive > 0, bcast_global(gl, an),
+                lambda gl, an: per_client(arrived, bcast_global(gl, an),
                                           an),
                 g, anchors)
-            pull = jnp.where(arrive > 0, r + 1, pull)
+            pull = jnp.where(arrived, r + 1, pull)
 
             conf = jax.vmap(local_eval)(params, x, y, mask)
             pooled = jax.lax.psum(conf.sum(axis=0), CLIENTS_AXIS)
@@ -272,8 +433,9 @@ def build_async_round_fn(mesh, apply_fn: Callable,
             # because `pull` only moved for arrivals and pre-update
             # `stale` already equals (r - pull) for everyone else.
             report_stale = stale
-            return (params, opt_state, anchors, pull, buf, nbuf, g,
-                    r + 1), (loss, conf, pooled, report_stale)
+            return (params, opt_state, anchors, pull, buf, nbuf, ring,
+                    rcount, g, r + 1), (loss, conf, pooled, report_stale,
+                                        scr, norms, n_arrived)
 
         # The current global, reconstructed once per compiled call from
         # the FRESHEST anchor: arrivals re-pull the new global right after
@@ -289,29 +451,40 @@ def build_async_round_fn(mesh, apply_fn: Callable,
                                                 keepdims=False)
 
         g0 = jax.tree.map(pick_freshest, anchors)
-        (params, opt_state, anchors, pull, buf, nbuf, _, _), stacked = \
-            jax.lax.scan(
-                scan_tick,
-                (params, opt_state, anchors, pull, buf, nbuf, g0, rnd),
-                arrivals)
-        loss, conf, pooled, stale = stacked
-        return (params, opt_state, anchors, pull, buf, nbuf, loss, conf,
-                pooled, stale)
+        (params, opt_state, anchors, pull, buf, nbuf, ring, rcount, _,
+         _), stacked = jax.lax.scan(
+            scan_tick,
+            (params, opt_state, anchors, pull, buf, nbuf, ring, rcount,
+             g0, rnd),
+            arrivals)
+        loss, conf, pooled, stale, scr, norms, acc = stacked
+        return (params, opt_state, anchors, pull, buf, nbuf, ring, rcount,
+                loss, conf, pooled, stale, scr, norms, acc)
 
     spec_c = P(CLIENTS_AXIS)
     spec_rc = P(None, CLIENTS_AXIS)
     sharded = jax.shard_map(
         tick_body, mesh=mesh,
-        in_specs=(spec_c, spec_c, spec_c, spec_c, P(), P(), spec_c, spec_c,
-                  spec_c, P(), spec_rc),
-        out_specs=(spec_c, spec_c, spec_c, spec_c, P(), P(), spec_rc,
-                   spec_rc, P(), spec_rc),
+        in_specs=(spec_c, spec_c, spec_c, spec_c, P(), P(), P(), P(),
+                  spec_c, spec_c, spec_c, P(), spec_rc),
+        out_specs=(spec_c, spec_c, spec_c, spec_c, P(), P(), P(), P(),
+                   spec_rc, spec_rc, P(), spec_rc, spec_rc, spec_rc, P()),
     )
 
     def _run(state, batch, arrivals):
         if buffered and "buf_delta" not in state:
             raise ValueError("buffer_size >= 2 needs a state initialized "
                              "with init_async_state(..., buffer_size=M)")
+        if screen and "screen_norms" not in state:
+            raise ValueError("screen=True needs a state initialized with "
+                             "init_async_state(..., screen_window=W) — "
+                             "'screen_norms' missing")
+        if not screen and "screen_norms" in state:
+            raise ValueError(
+                "state carries the defense screen ring (built with "
+                "screen_window=W) but this round_fn was built without "
+                "screen=True — the rolling median would silently freeze; "
+                "build the round_fn with screen=True")
         # M<=1 runs the same program with an all-zero buffer carry that
         # resets every arrival tick — no extra state keys, and bitwise
         # the per-tick apply (test-pinned).
@@ -320,20 +493,41 @@ def build_async_round_fn(mesh, apply_fn: Callable,
             state["anchors"]))
         nbuf = (state["buf_count"] if buffered
                 else jnp.zeros((), jnp.float32))
-        (params, opt_state, anchors, pull, buf, nbuf, loss, conf, pooled,
-         stale) = sharded(state["params"], state["opt_state"],
-                          state["anchors"], state["pull_tick"], buf, nbuf,
-                          batch["x"], batch["y"], batch["mask"],
-                          state["round"], arrivals)
+        if screen:
+            ring = state["screen_norms"]
+            if tuple(ring.shape) != (screen_window,):
+                raise ValueError(
+                    f"screen ring width {ring.shape} does not match "
+                    f"screen_window={screen_window}")
+            rcount = state["screen_count"]
+        else:
+            # Zero constants traced inside jit — no new arguments, so the
+            # screen-off recompile surface / audit contract is unchanged.
+            ring = jnp.zeros((1,), jnp.float32)
+            rcount = jnp.zeros((), jnp.int32)
+        (params, opt_state, anchors, pull, buf, nbuf, ring, rcount, loss,
+         conf, pooled, stale, scr, norms, acc) = sharded(
+            state["params"], state["opt_state"],
+            state["anchors"], state["pull_tick"], buf, nbuf, ring, rcount,
+            batch["x"], batch["y"], batch["mask"],
+            state["round"], arrivals)
         metrics = assemble_metrics(loss, conf, pooled, batch["mask"],
                                    ticks_per_step)
         metrics["staleness"] = (stale if ticks_per_step > 1 else stale[0])
+        if screen:
+            first = ticks_per_step > 1
+            metrics["screened"] = scr if first else scr[0]
+            metrics["update_norms"] = norms if first else norms[0]
+            metrics["accepted"] = acc if first else acc[0]
         new_state = {"params": params, "opt_state": opt_state,
                      "anchors": anchors, "pull_tick": pull,
                      "round": state["round"] + ticks_per_step}
         if buffered:
             new_state["buf_delta"] = buf
             new_state["buf_count"] = nbuf
+        if screen:
+            new_state["screen_norms"] = ring
+            new_state["screen_count"] = rcount
         return new_state, metrics
 
     if driven:

@@ -301,8 +301,11 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
     Byzantine-robust rules: a minority of arbitrarily corrupted client
     updates cannot move any coordinate beyond the honest majority's range
     (median/trimmed-mean) or be selected at all (krum). All are inherently
-    UNWEIGHTED and need every client's value, so they require full
-    participation and the psum/plain-averaging path.
+    UNWEIGHTED and ride the psum/plain-averaging path. The coordinate-wise
+    rules ('median'/'trimmed_mean') compose with client sampling — order
+    statistics run over the PARTICIPATING subset only (mask-aware, +inf
+    padding); the whole-update rules (krum/geometric_median) still require
+    full participation.
     ``byzantine_clients = k`` is the matching FAULT INJECTION: the first k
     clients' submitted updates are replaced in-graph with a 10x-amplified
     sign-flipped update (a strong model-poisoning attack) while their local
@@ -468,11 +471,25 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                    or aggregation != "psum"):
         raise ValueError("robust_aggregation composes with the plain psum "
                          "averaging path only (not server_opt/DP/compress/"
-                         "ring)")
-    if robust and sampling:
-        raise ValueError("robust_aggregation needs every client's value "
-                         "per coordinate — full participation required "
-                         "(participation_rate=1.0)")
+                         "ring); for robust aggregation at scale use the "
+                         "cohort robust path (cohort_size > 0 with "
+                         "robust_aggregation='median'/'trimmed_mean', "
+                         "fedtpu.cohort.scheduler)")
+    if robust and sampling and robust_aggregation in ("krum",
+                                                      "geometric_median"):
+        # The coordinate-wise rules below are mask-aware (order statistics
+        # over the participating subset); the whole-update rules are not —
+        # krum's resilience precondition n > 2f + 2 is over the REALIZED
+        # participant count, which a Bernoulli draw can push below any
+        # static bound, and Weiszfeld over absentee zero-updates is
+        # meaningless.
+        raise ValueError(
+            f"robust_aggregation={robust_aggregation!r} needs every "
+            "client's update — full participation required "
+            "(participation_rate=1.0); under client sampling use "
+            "'median'/'trimmed_mean' here, or the cohort robust path "
+            "(cohort_size > 0, fedtpu.cohort.scheduler) which samples "
+            "cohorts and applies mask-aware order statistics")
     if robust and weighting != "uniform":
         raise ValueError("robust aggregation is unweighted (order "
                          "statistics have no data-size weighting) — set "
@@ -794,17 +811,53 @@ def build_round_fn(mesh, apply_fn: Callable, tx: optax.GradientTransformation,
                     params = jax.tree.map(select_winner, gathered,
                                           agg_params)
                 else:
+                    if sampling:
+                        # Mask-aware order statistics: the median /
+                        # trimmed mean of the PARTICIPATING subset only.
+                        # Absentee rows are pushed to +inf so they sort
+                        # past every live value; the traced participant
+                        # count n then addresses the order statistics.
+                        part_all = jax.lax.all_gather(
+                            part, CLIENTS_AXIS).reshape(-1)   # (C,)
+                        n_act = part_all.sum()
+                        n_i = n_act.astype(jnp.int32)
+                        k_t = jnp.round(trim_ratio * n_act).astype(jnp.int32)
 
                     def ragg(p):
                         allc = gather_clients(p)
+                        if not sampling:
+                            if robust_aggregation == "median":
+                                glob = jnp.median(allc, axis=0)
+                            else:
+                                srt = jnp.sort(allc, axis=0)
+                                if k_trim:
+                                    srt = srt[k_trim:num_clients - k_trim]
+                                glob = srt.mean(axis=0)
+                            return bcast_global(glob, p)
+                        live = part_all.reshape(
+                            (num_clients,) + (1,) * (allc.ndim - 1))
+                        srt = jnp.sort(jnp.where(live > 0, allc, jnp.inf),
+                                       axis=0)
                         if robust_aggregation == "median":
-                            glob = jnp.median(allc, axis=0)
+                            lo = jax.lax.dynamic_index_in_dim(
+                                srt, jnp.maximum((n_i - 1) // 2, 0),
+                                keepdims=False)
+                            hi = jax.lax.dynamic_index_in_dim(
+                                srt, jnp.maximum(n_i // 2, 0),
+                                keepdims=False)
+                            glob = 0.5 * (lo + hi)
                         else:
-                            srt = jnp.sort(allc, axis=0)
-                            if k_trim:
-                                srt = srt[k_trim:num_clients - k_trim]
-                            glob = srt.mean(axis=0)
-                        return bcast_global(glob, p)
+                            j = jax.lax.broadcasted_iota(jnp.int32,
+                                                         srt.shape, 0)
+                            keep = (j >= k_t) & (j < n_i - k_t)
+                            denom = jnp.maximum(
+                                (n_i - 2 * k_t).astype(jnp.float32), 1.0)
+                            glob = jnp.where(keep, srt,
+                                             0.0).sum(axis=0) / denom
+                        # Zero participants: params carry over unchanged,
+                        # exactly like the averaging path.
+                        return jnp.where(n_act > 0, bcast_global(glob, p),
+                                         p)
 
                     params = jax.tree.map(ragg, agg_params)
             else:
@@ -959,6 +1012,17 @@ def global_params(state):
     return jax.tree.map(lambda p: p[0], state["params"])
 
 
+# Replicated SERVER state keys whose leading dim may coincidentally equal
+# num_clients (the defense screen's (window,) norm ring) — excluded from
+# the per-client selection BY NAME, never by shape, so a window == C
+# configuration cannot silently leak server state into the client store.
+_SERVER_ONLY_KEYS = frozenset({"screen_norms", "screen_count"})
+
+
+def _is_server_only(path) -> bool:
+    return any(getattr(k, "key", None) in _SERVER_ONLY_KEYS for k in path)
+
+
 def per_client_view(state, num_clients: int):
     """The PER-CLIENT leaves of a federated state, in flatten order.
 
@@ -969,27 +1033,32 @@ def per_client_view(state, num_clients: int):
     The cohort subsystem (fedtpu.cohort) persists exactly the per-client
     portion — one record per client id — so both engines and the store
     must agree on WHICH leaves those are. The single rule, applied here
-    and only here: ``ndim >= 1 and shape[0] == num_clients``.
+    and only here: ``ndim >= 1 and shape[0] == num_clients``, minus the
+    named replicated keys in ``_SERVER_ONLY_KEYS`` (whose leading dim can
+    collide with ``num_clients`` by coincidence).
 
     Returns the per-client leaves only, ordered by ``jax.tree.flatten``
     of the full state; pair with :func:`with_per_client` to rebuild a
     state around replaced per-client leaves. Works on both the sync
     (fedtpu.parallel.round) and async (fedtpu.parallel.async_fed) state
     layouts, and on host-numpy as well as device trees."""
-    leaves = jax.tree.leaves(state)
-    return [l for l in leaves
-            if getattr(l, "ndim", 0) >= 1 and l.shape[0] == num_clients]
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    return [l for p, l in flat
+            if not _is_server_only(p)
+            and getattr(l, "ndim", 0) >= 1 and l.shape[0] == num_clients]
 
 
 def with_per_client(state, num_clients: int, new_leaves):
     """Rebuild ``state`` with its per-client leaves (the
     :func:`per_client_view` selection, same order) replaced by
     ``new_leaves``; replicated leaves pass through untouched."""
-    leaves, treedef = jax.tree.flatten(state)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
     it = iter(new_leaves)
     out = []
-    for l in leaves:
-        if getattr(l, "ndim", 0) >= 1 and l.shape[0] == num_clients:
+    for p, l in flat:
+        if (not _is_server_only(p)
+                and getattr(l, "ndim", 0) >= 1
+                and l.shape[0] == num_clients):
             out.append(next(it))
         else:
             out.append(l)

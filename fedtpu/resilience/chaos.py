@@ -105,6 +105,22 @@ member owning the id-shard of clients matching its store shard:
                        lost admitted updates and an exact spool
                        handoff (spooled == replayed).
 
+The POISONING row closes the loop through the defense stack
+(fedtpu.robust; docs/robustness.md) — a 2-gateway fleet under the gang
+supervisor, the SAME heavy-tailed arrival process replayed three times:
+
+  mp_poison_campaign   Defended + poisoned (20% of users are seeded
+                       attackers submitting 10x sign-flipped updates),
+                       defenses-off + poisoned, and defended + clean.
+                       Bars: the defended fleet quarantines EXACTLY the
+                       trace's deterministic attacker set (no honest
+                       user quarantined), its model accuracy stays
+                       within ``POISON_ACCURACY_TOL`` of the clean
+                       baseline, zero gang restarts (containment must
+                       not cost availability), and the defenses-off run
+                       degrades by at least ``POISON_DEGRADE_MIN`` —
+                       proof the campaign would have landed.
+
 "History" is the ``--metrics-jsonl`` per-round record with timing
 stripped. Restarted/rolled-back runs append re-executed rounds to the
 same sink, so the comparison takes the LAST record per round — exactly
@@ -131,7 +147,7 @@ SCENARIOS = ("sigkill", "preempt", "nan_rollback", "dropout", "straggler",
              "mp_kill_worker", "mp_kill_coordinator", "mp_hang",
              "mp_preempt", "mp_shrink", "mp_grow", "mp_shrink_dead",
              "mp_autoscale_preempt", "mp_gateway_kill",
-             "mp_store_shard_kill")
+             "mp_store_shard_kill", "mp_poison_campaign")
 
 # The gang rows: 2 OS processes x 2 virtual CPU devices each, wired into
 # one jax.distributed runtime by `supervise --num-processes 2`. Their
@@ -159,6 +175,24 @@ GATEWAY_SCENARIOS = ("mp_gateway_kill", "mp_store_shard_kill")
 # incorporation for the whole restart window, so the tier's burn budget
 # sits above the autoscale drill's.
 GATEWAY_BURN_BUDGET = 2.5
+# The poisoning-containment row (fedtpu.robust; docs/robustness.md): a
+# 2-gateway fleet under the gang supervisor, replayed THREE times over
+# the same arrival process — defended + poisoned, defenses-off +
+# poisoned, defended + clean. Bars: every seeded attacker quarantined
+# and zero honest users quarantined (exact set equality against the
+# trace's deterministic attacker ids), the defended model's accuracy
+# within POISON_ACCURACY_TOL of the clean baseline, zero gang restarts
+# (containment must not cost availability), and the defenses-off run
+# demonstrably degraded (the fault actually bites).
+POISON_SCENARIO = "mp_poison_campaign"
+POISON_USERS = 40
+POISON_ARRIVALS = 900
+POISON_HORIZON_S = 30.0
+POISON_TRACE_SEED = 7
+POISON_FRAC = 0.2
+POISON_SCALE = 10.0
+POISON_ACCURACY_TOL = 0.01
+POISON_DEGRADE_MIN = 0.05
 MP_PROCESSES = 2
 MP_DEVICES_PER_PROC = 2
 # Watchdog budget for the gang rows: far above the tiny CPU job's
@@ -688,11 +722,143 @@ def _run_store_shard_kill(workdir: str, platform: str,
     return row
 
 
+def _poison_pass(passdir: str, trace: str, screen: bool, platform: str,
+                 timeout: int) -> dict:
+    """One mp_poison_campaign pass: a 2-gateway fleet under the gang
+    supervisor, the trace replayed through the retrying client with a
+    final drain, defense verdicts read off the per-gateway stats."""
+    import signal as _signal
+    os.makedirs(passdir, exist_ok=True)
+    port_base = os.path.join(passdir, "port")
+    sup_events = os.path.join(passdir, "sup.events.jsonl")
+    out = {"ok": False, "rc": -1, "gang_restarts": 0, "screened": 0,
+           "quarantined": [], "accuracy_min": None}
+    gw_args = ["gateway", "--platform", platform, "--num-gateways", "2",
+               "--port-file", port_base,
+               "--checkpoint-dir", os.path.join(passdir, "ck"),
+               "--cohort", "8", "--buffer-size", "2",
+               "--total-users", str(POISON_USERS), "--quiet"]
+    if screen:
+        gw_args += ["--screen", "--quarantine-strikes", "3"]
+    sup = None
+    try:
+        sup = subprocess.Popen(
+            [sys.executable, "-m", "fedtpu.cli", "supervise",
+             "--heartbeat", os.path.join(passdir, "hb"),
+             "--num-processes", "2", "--max-restarts", "2",
+             "--grace", "10", "--events", sup_events, "--", *gw_args],
+            env=_child_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        load = subprocess.run(
+            [sys.executable, "-m", "fedtpu.cli", "loadgen", trace,
+             "--port-file", port_base, "--num-gateways", "2",
+             "--batch", "256", "--quiet", "--json"],
+            env=_child_env(), capture_output=True, text=True,
+            timeout=timeout)
+        out["rc"] = load.returncode
+        if load.returncode != 0:
+            out["error"] = "loadgen failed"
+            out["stderr_tail"] = (load.stderr or "")[-2000:]
+            return out
+        summary = json.loads(load.stdout.strip().splitlines()[-1])
+        per = summary.get("server_stats") or {}
+        stats = [s for s in per.values() if s is not None]
+        out["screened"] = sum(int(s.get("screened") or 0) for s in stats)
+        out["quarantined"] = sorted(
+            {int(u) for s in stats for u in (s.get("quarantined") or [])})
+        accs = [s.get("eval_accuracy") for s in stats
+                if s.get("eval_accuracy") is not None]
+        out["accuracy_min"] = min(accs) if accs else None
+        sup.send_signal(_signal.SIGTERM)
+        sup_rc = sup.wait(timeout=timeout)
+        res = _resilience(sup_events)
+        out["gang_restarts"] = res.get("gang_restarts") or 0
+        out["ok"] = (sup_rc in (0, 75) and len(stats) == 2
+                     and out["accuracy_min"] is not None)
+        if not out["ok"]:
+            out["stderr_tail"] = ((sup.stderr.read() or "")
+                                  if sup.stderr else "")[-2000:]
+        return out
+    except (subprocess.TimeoutExpired, OSError, ConnectionError,
+            ValueError) as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+    finally:
+        if sup is not None and sup.poll() is None:
+            sup.kill()
+            sup.wait(timeout=30)
+
+
+def _run_poison_campaign(workdir: str, platform: str, timeout: int) -> dict:
+    """mp_poison_campaign (module docstring): three fleet passes over the
+    same arrival process — defended+poisoned, defenses-off+poisoned,
+    defended+clean — scored against the trace's deterministic attacker
+    set and the clean pass's accuracy."""
+    from fedtpu.serving.traces import (poisoned_user_ids, synthesize_trace,
+                                       write_trace)
+    name = POISON_SCENARIO
+    poisoned = os.path.join(workdir, f"{name}.poisoned.jsonl")
+    clean = os.path.join(workdir, f"{name}.clean.jsonl")
+    header, t, user, lat = synthesize_trace(
+        POISON_USERS, POISON_ARRIVALS, POISON_HORIZON_S,
+        seed=POISON_TRACE_SEED, poison_frac=POISON_FRAC,
+        poison_scale=POISON_SCALE)
+    write_trace(poisoned, header, t, user, lat)
+    # Same seed, no poison: identical arrival arrays, every user honest.
+    ch, ct, cu, cl = synthesize_trace(
+        POISON_USERS, POISON_ARRIVALS, POISON_HORIZON_S,
+        seed=POISON_TRACE_SEED)
+    write_trace(clean, ch, ct, cu, cl)
+    attackers = sorted(int(u) for u in poisoned_user_ids(
+        POISON_USERS, POISON_TRACE_SEED, POISON_FRAC))
+
+    row = _gateway_row(name)
+    row.update({"attackers": attackers, "quarantined": [],
+                "quarantined_honest": [], "missed_attackers": attackers,
+                "screened": 0, "accuracy_defended": None,
+                "accuracy_undefended": None, "accuracy_clean": None})
+    passes = {}
+    for tag, trace, screen in (("defended", poisoned, True),
+                               ("undefended", poisoned, False),
+                               ("clean", clean, True)):
+        p = _poison_pass(os.path.join(workdir, f"{name}.{tag}"), trace,
+                         screen, platform, timeout)
+        passes[tag] = p
+        if not p["ok"]:
+            row["error"] = f"{tag} pass failed: {p.get('error', 'see tail')}"
+            if "stderr_tail" in p:
+                row["stderr_tail"] = p["stderr_tail"]
+            row["rc"] = p["rc"]
+            return row
+    d, u, c = passes["defended"], passes["undefended"], passes["clean"]
+    atk = set(attackers)
+    row["rc"] = 0
+    row["screened"] = d["screened"]
+    row["quarantined"] = d["quarantined"]
+    row["quarantined_honest"] = sorted(set(d["quarantined"]) - atk)
+    row["missed_attackers"] = sorted(atk - set(d["quarantined"]))
+    row["accuracy_defended"] = d["accuracy_min"]
+    row["accuracy_undefended"] = u["accuracy_min"]
+    row["accuracy_clean"] = c["accuracy_min"]
+    row["gang_restarts"] = max(p["gang_restarts"] for p in passes.values())
+    row["survived"] = True
+    row["ok"] = (not row["missed_attackers"]
+                 and not row["quarantined_honest"]
+                 and row["gang_restarts"] == 0
+                 and d["accuracy_min"] >= c["accuracy_min"]
+                 - POISON_ACCURACY_TOL
+                 and u["accuracy_min"] <= c["accuracy_min"]
+                 - POISON_DEGRADE_MIN)
+    return row
+
+
 def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
                  num_clients: int, platform: str, timeout: int) -> dict:
     """One scenario run + verdict row (see module docstring for bars)."""
     if name == "mp_gateway_kill":
         return _run_gateway_kill(workdir, platform, timeout)
+    if name == POISON_SCENARIO:
+        return _run_poison_campaign(workdir, platform, timeout)
     if name == "mp_store_shard_kill":
         return _run_store_shard_kill(workdir, platform, timeout)
     if name == AUTOSCALE_SCENARIO:
@@ -820,9 +986,10 @@ def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
     os.makedirs(wd, exist_ok=True)
     try:
         baseline: dict = {}
-        if any(n not in GATEWAY_SCENARIOS for n in names):
-            # The gateway rows carry their own degraded-vs-degraded
-            # baseline inside the scenario; only training rows need the
+        if any(n not in GATEWAY_SCENARIOS and n != POISON_SCENARIO
+               for n in names):
+            # The gateway and poisoning rows carry their own baselines
+            # inside the scenario; only training rows need the
             # uninterrupted single-process run.
             if verbose:
                 print(f"[chaos] baseline run ({rounds} rounds, "
@@ -905,6 +1072,13 @@ def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
                              f"replayed={row['replayed']} "
                              f"adopted_rows={row['adopted_rows']} "
                              f"lost_updates={row['lost_updates']}")
+                if name == POISON_SCENARIO:
+                    gang += (f" quarantined={row['quarantined']} "
+                             f"honest={row['quarantined_honest']} "
+                             f"missed={row['missed_attackers']} "
+                             f"acc_def={row['accuracy_defended']} "
+                             f"acc_undef={row['accuracy_undefended']} "
+                             f"acc_clean={row['accuracy_clean']}")
                 print(f"[chaos]   {name}: {status} rc={row['rc']} "
                       f"survived={row['survived']} "
                       f"history_match={row['history_match']} "

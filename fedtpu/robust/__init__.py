@@ -1,0 +1,28 @@
+"""Poisoning defense at serving scale (docs/robustness.md).
+
+Defense-in-depth, three layers, each owned by the engine it protects:
+
+1. **Streaming screening** — fedtpu.parallel.async_fed grows an in-jit
+   screen stage (non-finite guard, norm-vs-rolling-median, cosine
+   against the server direction) that rejects a poisoned arrival BEFORE
+   it touches the K-buffer; the serving engine reads the per-tick
+   screened mask back and never counts a screened update as
+   incorporated.
+2. **Reputation / quarantine** — screened strikes accumulate per user
+   id in the ServingEngine; at the configured threshold the id is
+   quarantined (refused at offer(), durably flagged in the cohort
+   store's versioned reputation field so the decision rides the
+   flush/adopt digest fence and survives shard failover).
+3. **Robust aggregation** — the cohort engine's scan body can replace
+   its weighted psum with a mask-aware coordinate median or trimmed
+   mean (build_cohort_round_fn(robust=...)), and the vmap engine's
+   robust validator admits the same rules under client sampling.
+
+This package holds the jax-light glue: the deterministic defense
+simulation (``fedtpu check --defense-sim``) whose decision JSONL is
+golden-gated in tier-1, exactly like the autoscale control loop.
+"""
+
+from fedtpu.robust.defense_sim import (SIM_POISON_FRAC,  # noqa: F401
+                                       SIM_POISON_SCALE, SIM_SEED,
+                                       SIM_USERS, simulate)

@@ -1,0 +1,133 @@
+"""`fedtpu check --defense-sim` — deterministic poisoning-defense replay.
+
+Drives a REAL (small) :class:`fedtpu.serving.engine.ServingEngine` with
+screening enabled over a seeded adversarial trace
+(fedtpu.serving.traces, v2 poison mode) in pure virtual time, then
+canonicalizes the engine's defense decision log — one JSON line per
+screen strike / quarantine — and compares it bitwise against the
+committed golden (``tests/goldens/defense_sim.jsonl``), reusing the
+autoscale control plane's write/compare machinery.
+
+Why a golden and not a threshold assertion: the defense is a CHAIN
+(arrival weight -> in-jit screen verdict -> host strike -> quarantine ->
+store flag), and a silent change anywhere in it — the screen math, the
+ring-median warmup, the strike threshold, the trace synthesizer — moves
+the decision stream. The golden turns every such move into a reviewed
+regeneration instead of an accident, exactly the contract the autoscale
+and audit goldens already enforce.
+
+Unlike the autoscale sim this module does touch jax (the engine ticks
+are real shard_map programs), so it lives outside the jax-free CLI
+paths and only runs when explicitly invoked.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+# One write/compare implementation repo-wide: the autoscale golden gate
+# and this one must never drift in format or failure reporting.
+from fedtpu.autoscale.controller import compare_decisions, write_decisions
+
+# ---------------------------------------------------------------------------
+# Simulation contract: these constants are part of the committed golden
+# (tests/goldens/defense_sim.jsonl). Changing ANY of them — or the
+# screen math in async_fed, the strike/quarantine logic in the engine,
+# the default ServingConfig screen knobs, or the trace synthesizer —
+# legitimately regenerates the golden; the gate exists so that
+# regeneration is a reviewed decision, not an accident.
+
+SIM_USERS = 40
+SIM_ARRIVALS = 600
+SIM_HORIZON_S = 30.0
+SIM_SEED = 7
+SIM_POISON_FRAC = 0.2
+SIM_POISON_SCALE = 10.0
+# Engine shape: small enough that the sim is a few seconds on CPU, big
+# enough that slots coalesce and the K-buffer actually buffers.
+SIM_COHORT = 8
+SIM_BUFFER = 2
+SIM_TICK_INTERVAL_S = 0.5
+SIM_QUARANTINE_STRIKES = 3
+
+
+def _sim_config():
+    from fedtpu.config import ServingConfig
+    return ServingConfig(
+        cohort=SIM_COHORT, buffer_size=SIM_BUFFER,
+        tick_interval_s=SIM_TICK_INTERVAL_S,
+        data_rows=64, model_hidden=(8,), seed=0,
+        screen=True, quarantine_strikes=SIM_QUARANTINE_STRIKES)
+
+
+def simulate(*, trace_path: Optional[str] = None,
+             users: int = SIM_USERS, arrivals: int = SIM_ARRIVALS,
+             horizon_s: float = SIM_HORIZON_S, seed: int = SIM_SEED,
+             poison_frac: float = SIM_POISON_FRAC,
+             poison_scale: float = SIM_POISON_SCALE,
+             registry=None, tracer=None) -> dict:
+    """Replay the adversarial trace through a screening engine. Returns
+    ``{"lines": [...], "summary": {...}}`` where ``lines`` is the
+    canonical defense-decision JSONL (one line per screen strike or
+    quarantine, virtual-time-derived only) and ``summary`` scores the
+    campaign: who was quarantined vs who actually attacked, and the
+    final model accuracy (the containment metric)."""
+    from fedtpu.serving.engine import ServingEngine
+    from fedtpu.serving.traces import poisoned_user_ids, read_trace
+    from fedtpu.telemetry.metrics import MetricsRegistry
+
+    if trace_path:
+        header, events = read_trace(trace_path)
+        rows = [([ev.user, ev.t, ev.lat, None, ev.poison]
+                 if ev.poison > 0.0 else [ev.user, ev.t, ev.lat])
+                for ev in events]
+        users, seed = header.users, header.seed
+        poison_frac = float(header.params.get("poison_frac", 0.0))
+    else:
+        from fedtpu.serving.traces import synthesize_trace
+        header, t, user, lat = synthesize_trace(
+            users, arrivals, horizon_s, seed=seed,
+            poison_frac=poison_frac, poison_scale=poison_scale)
+        attackers_arr = poisoned_user_ids(users, seed, poison_frac)
+        atk = frozenset(int(u) for u in attackers_arr)
+        rows = [([int(user[i]), float(t[i]), float(lat[i]), None,
+                  float(poison_scale)] if int(user[i]) in atk
+                 else [int(user[i]), float(t[i]), float(lat[i])])
+                for i in range(len(t))]
+    attackers = sorted(int(u) for u in
+                       poisoned_user_ids(users, seed, poison_frac))
+
+    eng = ServingEngine(
+        _sim_config(),
+        registry=registry if registry is not None else MetricsRegistry(),
+        tracer=tracer)
+    counts = eng.offer_many(rows)
+    eng.drain()
+
+    lines = [json.dumps(row, sort_keys=True, separators=(",", ":"))
+             for row in eng.defense_log]
+    quarantined = sorted(eng.quarantined)
+    atk_set = set(attackers)
+    summary = {
+        "arrivals": len(rows),
+        "admission": {k: int(v) for k, v in sorted(counts.items())},
+        "ticks": eng.tick_count,
+        "incorporated": eng.incorporated,
+        "screened": eng.screened_total,
+        "attackers": attackers,
+        "quarantined": quarantined,
+        "quarantined_attackers": sorted(u for u in quarantined
+                                        if u in atk_set),
+        "quarantined_honest": sorted(u for u in quarantined
+                                     if u not in atk_set),
+        "eval_accuracy": eng.eval_accuracy(),
+    }
+    if tracer is not None:
+        tracer.event("defense_sim_summary", **summary)
+    return {"lines": lines, "summary": summary}
+
+
+__all__ = ["simulate", "write_decisions", "compare_decisions",
+           "SIM_USERS", "SIM_ARRIVALS", "SIM_HORIZON_S", "SIM_SEED",
+           "SIM_POISON_FRAC", "SIM_POISON_SCALE"]

@@ -33,5 +33,6 @@ construction.
 from fedtpu.serving.admission import (AdmissionController,  # noqa: F401
                                       TokenBucket, VERDICTS)
 from fedtpu.serving.traces import (TRACE_SCHEMA_VERSION,  # noqa: F401
-                                   read_trace, synthesize_trace,
-                                   write_trace)
+                                   TRACE_SCHEMA_VERSION_POISON,
+                                   poisoned_user_ids, read_trace,
+                                   synthesize_trace, write_trace)

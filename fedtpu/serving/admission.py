@@ -47,10 +47,17 @@ DEPRIORITIZE = "deprioritize"
 REJECT_RATE = "reject_rate"
 REJECT_STALE = "reject_stale"
 REJECT_BACKPRESSURE = "reject_backpressure"
+# Defense verdict (fedtpu.robust; docs/robustness.md): the update was
+# admitted by the checks above but refused by the poisoning screen (or
+# its sender is quarantined). Counted through record(), never decide() —
+# screening happens at/after the engine boundary, not in the token path.
+SCREENED = "screened"
 
-# Verdict order is display / schema order, not check order.
+# Verdict order is display / schema order, not check order. SCREENED
+# must stay LAST: checkpoints store counts as a list in this order, and
+# restore_state zips — old 5-entry checkpoints restore as a prefix.
 VERDICTS = (ACCEPT, DEPRIORITIZE, REJECT_RATE, REJECT_STALE,
-            REJECT_BACKPRESSURE)
+            REJECT_BACKPRESSURE, SCREENED)
 
 ADMITTED = frozenset({ACCEPT, DEPRIORITIZE})
 
@@ -145,6 +152,16 @@ class AdmissionController:
         if staleness > p.stale_deprioritize:
             return self._count(DEPRIORITIZE, now)
         return self._count(ACCEPT, now)
+
+    def record(self, verdict: str, now: float = 0.0) -> str:
+        """Count a verdict decided OUTSIDE the policy checks — the
+        defense screen's rejections (quarantine refusals at offer time,
+        in-tick screened updates). Pure bookkeeping: no token is spent,
+        so a screened update still consumed its rate token at decide()
+        time, exactly like any other admitted-then-dropped frame."""
+        if verdict not in self.counts:
+            raise ValueError(f"unknown verdict {verdict!r}")
+        return self._count(verdict, now)
 
     def _count(self, verdict: str, now: float = 0.0) -> str:
         self.counts[verdict] += 1

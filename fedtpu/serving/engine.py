@@ -61,8 +61,9 @@ from typing import Optional
 
 import numpy as np
 
-from fedtpu.serving.admission import (ADMITTED, DEPRIORITIZE, VERDICTS,
-                                      AdmissionController, AdmissionPolicy)
+from fedtpu.serving.admission import (ADMITTED, DEPRIORITIZE, SCREENED,
+                                      VERDICTS, AdmissionController,
+                                      AdmissionPolicy)
 from fedtpu.telemetry.metrics import (Histogram, MetricsRegistry,
                                       default_registry)
 from fedtpu.telemetry.report import _percentiles
@@ -93,6 +94,13 @@ LATENCY_WINDOW = 100_000
 _APPLIES_MAX = 8192
 _APPLIES_KEEP = 4096
 
+# Rolling-norm ring width for the defense screen (cfg.screen=True): the
+# in-graph rolling median spans this many accepted ticks. Fixed rather
+# than configurable — the ring rides the engine state/checkpoints, and a
+# width change would invalidate every checkpoint for a tuning knob
+# nobody needs to turn (warmup/mult are the tuning surface).
+SCREEN_WINDOW = 64
+
 
 @dataclass(frozen=True)
 class _Pending:
@@ -101,6 +109,7 @@ class _Pending:
     t: float            # virtual arrival time
     user: int
     elig_tick: int      # first tick index this entry may ride
+    poison: float = 0.0  # adversarial weight scale (traces v2); 0 = honest
 
 
 class SlotBinder:
@@ -231,6 +240,9 @@ class ServingEngine:
         self.C = int(cfg.cohort)
         self.M = int(cfg.buffer_size)
         self._apply_n = self.M if self.M >= 2 else 1
+        # Poisoning defense (fedtpu.robust; docs/robustness.md).
+        self.screen = bool(getattr(cfg, "screen", False))
+        self.quarantine_strikes = int(getattr(cfg, "quarantine_strikes", 3))
 
         self.admission = AdmissionController(
             AdmissionPolicy(rate_limit=cfg.rate_limit,
@@ -277,17 +289,39 @@ class ServingEngine:
                        "mask": packed.mask}.items()}
         self.state = async_fed.init_async_state(
             jax.random.key(cfg.seed), self.mesh, self.C, init_fn, tx,
-            same_init=True, buffer_size=self.M)
+            same_init=True, buffer_size=self.M,
+            screen_window=SCREEN_WINDOW if self.screen else 0)
         self.step = async_fed.build_async_round_fn(
             self.mesh, apply_fn, tx, cfg.data_classes,
             staleness_power=cfg.staleness_power, server_lr=cfg.server_lr,
             local_steps=cfg.local_steps, buffer_size=self.M,
-            ticks_per_step=1, driven=True)
+            ticks_per_step=1, driven=True,
+            screen=self.screen,
+            screen_norm_mult=float(getattr(cfg, "screen_norm_mult", 4.0)),
+            screen_cos_min=float(getattr(cfg, "screen_cos_min", -0.2)),
+            screen_warmup=int(getattr(cfg, "screen_warmup", 8)),
+            screen_window=SCREEN_WINDOW,
+            clip_norm=float(getattr(cfg, "screen_clip_norm", 0.0)))
+        # Retained for summary()'s eval_accuracy — the chaos containment
+        # row compares defended vs undefended final accuracy through the
+        # stats protocol op. Full (unsharded) fixture copy: tiny.
+        self.apply_fn = apply_fn
+        self._eval_xy = (np.asarray(x), np.asarray(y))
 
         # Host-side serving state (all of it checkpointed; see
         # checkpoint()/restore()).
         self.binder = SlotBinder(self.C)
         self.store = None            # optional ClientStateStore (attach_store)
+        # Defense reputation: screened-update strikes per user; at
+        # quarantine_strikes the user id is quarantined — refused at
+        # offer() and, when a store is attached, flagged durably in its
+        # record (version-bumped, rides the flush/adopt digest fence).
+        self.strikes: dict = {}
+        self.quarantined: set = set()
+        self.screened_total = 0
+        # Canonical defense decision rows (virtual-time-derived only) —
+        # the defense_sim golden artifact reads these.
+        self.defense_log: list = []
         self.pending: list[_Pending] = []
         self.tick_count = 0
         self.version = 0
@@ -327,16 +361,23 @@ class ServingEngine:
             del self._applies_v[:-_APPLIES_KEEP]
 
     def offer(self, t: float, user: int, lat: float,
-              version: Optional[int] = None) -> str:
+              version: Optional[int] = None, poison: float = 0.0) -> str:
         """Admit (or not) one arriving update; fires any due ticks first.
 
         Returns the admission verdict. Admitted updates queue per USER
         (the slot is bound at tick time by the :class:`SlotBinder`) and
         become eligible at the NEXT tick (one tick later when
-        deprioritized).
+        deprioritized). ``poison`` is the trace-carried adversarial
+        weight scale (0 for honest updates) — the fault-injection hook
+        the defense screen is measured against.
         """
         self.clock.advance(t)
         self._fire_due()
+        if int(user) in self.quarantined:
+            # Quarantined senders are refused at the door — no token
+            # spent, no queue entry, counted under admission_screened.
+            self.registry.counter("serve_quarantine_refusals").inc()
+            return self.admission.record(SCREENED, self.clock.now)
         pulled = (int(version) if version is not None
                   else self.pulled_version(t - lat))
         staleness = max(0, self.version - pulled)
@@ -345,19 +386,26 @@ class ServingEngine:
         if verdict in ADMITTED:
             elig = self.tick_count + (2 if verdict == DEPRIORITIZE else 1)
             self.pending.append(_Pending(t=float(t), user=int(user),
-                                         elig_tick=elig))
+                                         elig_tick=elig,
+                                         poison=float(poison)))
             self.registry.gauge("serve_pending").set(len(self.pending))
             if self.flush_every and self._eligible_count() >= self.flush_every:
                 self._tick(self.clock.now)
         return verdict
 
     def offer_many(self, events) -> dict:
-        """Batch ingestion: ``events`` is an iterable of (user, t, lat)
-        rows (the protocol's ``updates`` frame / trace replay). Returns
-        per-verdict counts for the batch."""
+        """Batch ingestion: ``events`` is an iterable of
+        ``(user, t, lat)`` rows, optionally extended with
+        ``version`` and ``poison`` columns (the protocol's ``updates``
+        frame / trace replay). Returns per-verdict counts for the
+        batch."""
         counts: dict = {}
-        for user, t, lat in events:
-            v = self.offer(float(t), int(user), float(lat))
+        for row in events:
+            version = (int(row[3]) if len(row) > 3 and row[3] is not None
+                       else None)
+            poison = float(row[4]) if len(row) > 4 else 0.0
+            v = self.offer(float(row[1]), int(row[0]), float(row[2]),
+                           version=version, poison=poison)
             counts[v] = counts.get(v, 0) + 1
         return counts
 
@@ -392,7 +440,7 @@ class ServingEngine:
 
     def wal_append(self, nonce, seq, rows) -> None:
         """Durability write for one admitted frame: rows are
-        ``[user, t, lat]`` (optionally ``+ [version]``). Appended +
+        ``[user, t, lat]`` (optionally ``+ [version, poison]``). Appended +
         flushed BEFORE the frame is processed, so every acked update is
         either in a checkpoint or in the WAL; checkpoint() truncates it
         once state is durable. No-op until ``wal_path`` is set."""
@@ -441,7 +489,10 @@ class ServingEngine:
                 for r in rows:
                     v = self.offer(float(r[1]), int(r[0]), float(r[2]),
                                    version=(int(r[3]) if len(r) > 3
-                                            else None))
+                                            and r[3] is not None
+                                            else None),
+                                   poison=(float(r[4]) if len(r) > 4
+                                           else 0.0))
                     counts[v] = counts.get(v, 0) + 1
                     replayed += 1
                 self.session_commit(entry.get("nonce"), entry.get("seq"),
@@ -506,7 +557,11 @@ class ServingEngine:
         self.store.write(
             np.asarray([evicted_user], np.int64),
             [np.asarray(v)[None] for v in vals])  # fedtpu: noqa[FTP001] eviction writeback is a host store path, off the tick's device step
-        if int(self.store.versions(
+        # Participation, not version, decides whether a record holds real
+        # slot state: reputation writes (set_reputation) bump the version
+        # without touching the leaves, and swapping such a zero-filled
+        # record into a live slot would wipe it.
+        if int(self.store.participation(
                 np.asarray([new_user], np.int64))[0]) > 0:
             rec = self.store.read(np.asarray([new_user], np.int64))
             self.state = write_client_slot(self.state, self.C, slot,
@@ -542,38 +597,105 @@ class ServingEngine:
             return 0
         self.pending = [p for p in self.pending
                         if not (drain or p.elig_tick <= k)]
+        # Entries admitted before their sender was quarantined are
+        # dropped here, not incorporated — containment covers the queue.
+        if self.quarantined:
+            dropped = [p for p in ready if p.user in self.quarantined]
+            if dropped:
+                ready = [p for p in ready
+                         if p.user not in self.quarantined]
+                for _ in dropped:
+                    self.admission.record(SCREENED, t_fire)
+                self.registry.counter("serve_quarantine_refusals").inc(
+                    len(dropped))
+            if not ready:
+                self._record_tick(t_fire, 0, 0)
+                return 0
         # Stable identity binding, in arrival order (deterministic under
         # replay). Two distinct ready users always land on two distinct
         # slots — the residue map's aliasing cannot happen.
         tick_slots = set()
+        poison_of: dict = {}
+        user_of: dict = {}
         for p in ready:
             slot, evicted = self.binder.bind(p.user)
             if evicted is not None and self.store is not None:
                 self._swap_slot(slot, evicted, p.user)
             tick_slots.add(slot)
+            user_of[slot] = p.user
+            # Coalesced entries on one slot: a poisoned one dominates —
+            # the arrival carries the strongest adversarial weight.
+            poison_of[slot] = max(poison_of.get(slot, 0.0),
+                                  float(p.poison))
         slots = sorted(tick_slots)
         mask = np.zeros((1, self.C), np.float32)
-        mask[0, slots] = 1.0
-        self.state, _metrics = self.step(self.state, self.batch, mask)
-        # Host mirror of the in-graph K-buffer apply rule: each arriving
-        # SLOT counts one buffered update; the global (and therefore the
-        # version clients pull) moves when apply_n have accumulated.
-        self.nbuf_host += float(len(slots))
+        for s in slots:
+            mask[0, s] = -poison_of[s] if poison_of[s] > 0 else 1.0
+        self.state, metrics = self.step(self.state, self.batch, mask)
+        scr_slots: set = set()
+        if self.screen:
+            # One (C,) fetch per tick — the screening verdict is computed
+            # in-graph and the strike/quarantine bookkeeping is host-side
+            # by design. fedtpu: noqa[FTP001] defense verdict readback
+            scr = np.asarray(metrics["screened"])
+            scr_slots = {s for s in slots if scr[s] > 0}
+            for s in sorted(scr_slots):
+                self._strike(user_of[s], t_fire)
+        incorporated = [p for p in ready
+                        if self.binder.peek(p.user) not in scr_slots]
+        n_screened = len(ready) - len(incorporated)
+        if n_screened:
+            for _ in range(n_screened):
+                self.admission.record(SCREENED, t_fire)
+            self.screened_total += n_screened
+            self.tracer.event("serve_screened", round=self.tick_count,
+                              t_virtual=float(t_fire),
+                              n_screened=n_screened)
+        # Host mirror of the in-graph K-buffer apply rule: each ACCEPTED
+        # arriving slot counts one buffered update; the global (and
+        # therefore the version clients pull) moves when apply_n have
+        # accumulated. Screened slots never joined the device buffer.
+        self.nbuf_host += float(len(slots) - len(scr_slots))
         if self.nbuf_host >= self._apply_n:
             self.version += 1
             self.nbuf_host = 0.0
             self._applies_t.append(t_fire)
             self._applies_v.append(self.version)
             self._compact_applies()
-        lats = np.asarray([t_fire - p.t for p in ready], np.float64)
+        lats = np.asarray([t_fire - p.t for p in incorporated], np.float64)
         _observe_array(self._lat_hist, lats)
         self.latencies.extend(lats.tolist())
         if len(self.latencies) > LATENCY_WINDOW:
             del self.latencies[:len(self.latencies) - LATENCY_WINDOW]
-        self.incorporated += len(ready)
-        self.registry.counter("serve_updates_incorporated").inc(len(ready))
-        self._record_tick(t_fire, len(ready), len(slots))
-        return len(ready)
+        self.incorporated += len(incorporated)
+        self.registry.counter("serve_updates_incorporated").inc(
+            len(incorporated))
+        self._record_tick(t_fire, len(incorporated), len(slots))
+        return len(incorporated)
+
+    def _strike(self, user: int, t_fire: float) -> None:
+        """One screened-update strike against ``user``; quarantines at
+        the configured threshold. Both decisions are pure functions of
+        the virtual-time tick stream, so they replay bitwise."""
+        user = int(user)
+        n = self.strikes.get(user, 0) + 1
+        self.strikes[user] = n
+        self.defense_log.append(
+            {"kind": "screen", "tick": self.tick_count,
+             "t": float(t_fire), "user": user, "strikes": n})
+        if n >= self.quarantine_strikes and user not in self.quarantined:
+            self.quarantined.add(user)
+            self.defense_log.append(
+                {"kind": "quarantine", "tick": self.tick_count,
+                 "t": float(t_fire), "user": user})
+            self.registry.counter("serve_quarantines").inc()
+            self.tracer.event("serve_quarantine", round=self.tick_count,
+                              t_virtual=float(t_fire), user=user,
+                              strikes=n)
+            if self.store is not None:
+                self.store.set_reputation(
+                    np.asarray([user], np.int64),
+                    np.asarray([n], np.uint32), True)
 
     def _record_tick(self, t_fire: float, n_updates: int,
                      n_slots: int) -> None:
@@ -634,8 +756,27 @@ class ServingEngine:
             "wall_s": wall,
             "rounds_per_sec": (self.tick_count / wall) if wall > 0 else 0.0,
             "signals": self.signals(),
+            # Defense block (present even with screen off, so chaos'
+            # undefended control run reads the same keys): quarantined
+            # ids, screened count, and the global model's accuracy on
+            # the engine's training fixture — the containment metric.
+            "screened": self.screened_total,
+            "quarantined": sorted(self.quarantined),
+            "eval_accuracy": self.eval_accuracy(),
         }
         return out
+
+    def eval_accuracy(self) -> float:
+        """Accuracy of the CURRENT global model on the full serving
+        fixture — the poisoning-containment metric (a landed campaign
+        tanks it; a contained one stays at the attacker-free baseline).
+        One tiny forward pass; fine at stats-poll cadence."""
+        import jax
+        from fedtpu.parallel.async_fed import async_global_params
+        g = jax.tree.map(np.asarray, async_global_params(self.state))
+        x, y = self._eval_xy
+        logits = np.asarray(self.apply_fn(g, x))
+        return float((logits.argmax(axis=-1) == y).mean())
 
     def signals(self) -> dict:
         """The machine-readable block the autoscale control plane polls
@@ -711,7 +852,8 @@ class ServingEngine:
         with open(tmp, "w", encoding="utf-8") as fh:
             for p in self.pending:
                 fh.write(json.dumps(
-                    {"t": p.t, "user": p.user, "elig_tick": p.elig_tick},
+                    {"t": p.t, "user": p.user, "elig_tick": p.elig_tick,
+                     "poison": p.poison},
                     sort_keys=True, separators=(",", ":")) + "\n")
         os.replace(tmp, path)
         n = len(self.pending)
@@ -767,6 +909,21 @@ class ServingEngine:
                                             np.int64)
             extra["pend_elig"] = np.asarray(
                 [p.elig_tick for p in self.pending], np.int64)
+            extra["pend_poison"] = np.asarray(
+                [p.poison for p in self.pending], np.float64)
+        # Defense reputation: strikes + quarantine must survive a resume
+        # or the post-restore verdict stream diverges (a quarantined
+        # attacker would be re-admitted). Absent in pre-defense
+        # checkpoints; restore treats absence as empty.
+        extra["serve_screened_total"] = np.int64(self.screened_total)
+        if self.strikes:
+            users = sorted(self.strikes)
+            extra["strike_users"] = np.asarray(users, np.int64)
+            extra["strike_counts"] = np.asarray(
+                [self.strikes[u] for u in users], np.int64)
+        if self.quarantined:
+            extra["quarantined_users"] = np.asarray(
+                sorted(self.quarantined), np.int64)
         if self._applies_t:
             extra["applies_t"] = np.asarray(self._applies_t)
             extra["applies_v"] = np.asarray(self._applies_v, np.int64)
@@ -847,11 +1004,28 @@ class ServingEngine:
                                np.atleast_1d(meta["serve_lat_buckets"])]
         self.pending = []
         if meta.get("pend_t") is not None:
-            for t, u, e in zip(np.atleast_1d(meta["pend_t"]),
-                               np.atleast_1d(meta["pend_user"]),
-                               np.atleast_1d(meta["pend_elig"])):
+            pt = np.atleast_1d(meta["pend_t"])
+            pois = np.atleast_1d(meta.get("pend_poison",
+                                          np.zeros(pt.shape)))
+            for t, u, e, pz in zip(pt,
+                                   np.atleast_1d(meta["pend_user"]),
+                                   np.atleast_1d(meta["pend_elig"]),
+                                   pois):
                 self.pending.append(_Pending(t=float(t), user=int(u),
-                                             elig_tick=int(e)))
+                                             elig_tick=int(e),
+                                             poison=float(pz)))
+        self.screened_total = int(np.asarray(
+            meta.get("serve_screened_total", 0)))
+        self.strikes = {}
+        if meta.get("strike_users") is not None:
+            self.strikes = {
+                int(u): int(n) for u, n in
+                zip(np.atleast_1d(meta["strike_users"]),
+                    np.atleast_1d(meta["strike_counts"]))}
+        self.quarantined = set()
+        if meta.get("quarantined_users") is not None:
+            self.quarantined = {
+                int(u) for u in np.atleast_1d(meta["quarantined_users"])}
         if meta.get("bind_users") is not None:
             self.binder.restore_state(
                 np.atleast_1d(meta["bind_users"]),

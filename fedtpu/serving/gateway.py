@@ -196,7 +196,8 @@ def _gateway_handle(gw: _Gateway, engine, msg: dict) -> dict:
                         continue
                     entry = json.loads(line)
                     engine.offer(float(entry["t"]), int(entry["user"]),
-                                 0.0)
+                                 0.0,
+                                 poison=float(entry.get("poison", 0.0)))
                     replayed += 1
         engine.registry.counter("gateway_adoptions").inc()
         engine.tracer.event("gateway_adopt", round=engine.tick_count,

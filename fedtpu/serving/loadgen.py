@@ -96,7 +96,13 @@ def run_loadgen(trace_path: str, host: str = "127.0.0.1",
             pending.clear()
 
         for ev in events:
-            pending.append([ev.user, ev.t, ev.lat])
+            # v2 adversarial traces: attacker events ride a 5-element row
+            # (version slot None) so honest frames stay byte-identical to
+            # the v1 wire format.
+            if ev.poison > 0.0:
+                pending.append([ev.user, ev.t, ev.lat, None, ev.poison])
+            else:
+                pending.append([ev.user, ev.t, ev.lat])
             if len(pending) >= batch:
                 _flush()
             if max_events and sent + len(pending) >= max_events:

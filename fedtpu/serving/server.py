@@ -84,11 +84,18 @@ def _handle(engine: ServingEngine, msg: dict) -> dict:
                    float(msg.get("lat", 0.0))]
             if msg.get("version") is not None:
                 row.append(int(msg["version"]))
+            if float(msg.get("poison", 0.0)) > 0.0:
+                # Poison rides index 4 (the WAL/replay layout); pad the
+                # version slot so the row stays positional.
+                if len(row) == 3:
+                    row.append(None)
+                row.append(float(msg["poison"]))
         except (KeyError, TypeError, ValueError) as e:
             return protocol.error_msg(f"bad update frame: {e}")
         engine.wal_append(nonce, seq, [row])
         verdict = engine.offer(row[1], row[0], row[2],
-                               version=(row[3] if len(row) > 3 else None))
+                               version=(row[3] if len(row) > 3 else None),
+                               poison=(float(row[4]) if len(row) > 4 else 0.0))
         engine.session_commit(nonce, seq, {verdict: 1})
         return {"op": "ack", "verdict": verdict, "version": engine.version}
     if op == "updates":

@@ -39,6 +39,17 @@ from typing import IO, Iterator
 import numpy as np
 
 TRACE_SCHEMA_VERSION = 1
+# v2 adds the ADVERSARIAL-USER mode (fedtpu.robust; docs/robustness.md):
+# a seeded, deterministic attacker id set whose arrival lines carry a
+# "poison" field — the amplified sign-flip weight scale the serving
+# engine injects as a negative arrival weight. A v2 reader accepts v1
+# files unchanged; plain synthesis (poison_frac=0) still writes v1, so
+# existing goldens and trace fixtures stay byte-identical.
+TRACE_SCHEMA_VERSION_POISON = 2
+_READABLE_VERSIONS = (1, 2)
+# Seed decorrelation for the attacker draw: the attacker set must not
+# correlate with the arrival process drawn from the same seed.
+_POISON_SEED_SALT = 0x9E3779B9
 
 
 @dataclass(frozen=True)
@@ -68,11 +79,37 @@ class TraceHeader:
 
 @dataclass(frozen=True)
 class Arrival:
-    """One client-update arrival. ``t`` >= ``lat`` >= 0; ``t`` is ascending."""
+    """One client-update arrival. ``t`` >= ``lat`` >= 0; ``t`` is ascending.
+
+    ``poison`` is 0.0 for honest arrivals (every v1 arrival). For a v2
+    adversarial trace it is the positive sign-flip scale the serving
+    engine turns into a negative arrival weight (``-poison``) so the
+    screen has something real to catch.
+    """
 
     t: float
     user: int
     lat: float
+    poison: float = 0.0
+
+
+def poisoned_user_ids(users: int, seed: int, poison_frac: float) -> np.ndarray:
+    """The deterministic attacker id set for a v2 adversarial trace.
+
+    A seeded permutation of the user range, decorrelated from the
+    arrival RNG by salting the seed, truncated to
+    ``round(poison_frac * users)`` ids. Shared by the synthesizer (to
+    mark arrival lines), the defense sim (to score quarantine
+    precision), and the chaos campaign (to assert containment), so the
+    three can never disagree about who the attackers were.
+    """
+    if not (0.0 <= poison_frac <= 1.0):
+        raise ValueError("poison_frac must be in [0, 1]")
+    k = int(round(poison_frac * users))
+    if k == 0:
+        return np.empty(0, dtype=np.int64)
+    rng = np.random.default_rng(seed ^ _POISON_SEED_SALT)
+    return np.sort(rng.permutation(users)[:k]).astype(np.int64)
 
 
 def synthesize_trace(users: int,
@@ -82,7 +119,9 @@ def synthesize_trace(users: int,
                      zipf_a: float = 1.2,
                      gap_sigma: float = 1.0,
                      lat_mean_s: float = 0.5,
-                     lat_sigma: float = 0.75) -> tuple[TraceHeader, np.ndarray, np.ndarray, np.ndarray]:
+                     lat_sigma: float = 0.75,
+                     poison_frac: float = 0.0,
+                     poison_scale: float = 10.0) -> tuple[TraceHeader, np.ndarray, np.ndarray, np.ndarray]:
     """Draw a heavy-tailed arrival trace; fully vectorized, one RNG.
 
     Returns ``(header, t, user, lat)`` as numpy arrays sorted by ``t``.
@@ -93,9 +132,20 @@ def synthesize_trace(users: int,
       last arrival lands at ``horizon_s`` (bursty but bounded horizon).
     - client latency ~ lognormal around ``lat_mean_s`` (stragglers pull
       stale versions; the tail drives reject_stale).
+
+    ``poison_frac > 0`` enables the adversarial mode: the header becomes
+    v2 and records ``poison_frac``/``poison_scale`` in ``params``. The
+    arrival *arrays are unchanged* — attackers are a deterministic
+    function of the header (:func:`poisoned_user_ids`), and
+    :func:`write_trace` marks their lines. With ``poison_frac == 0``
+    the output is byte-identical to a v1 trace from the same seed.
     """
     if users < 1 or arrivals < 1:
         raise ValueError("users and arrivals must be >= 1")
+    if not (0.0 <= poison_frac <= 1.0):
+        raise ValueError("poison_frac must be in [0, 1]")
+    if poison_frac > 0.0 and poison_scale <= 0.0:
+        raise ValueError("poison_scale must be > 0 when poison_frac > 0")
     rng = np.random.default_rng(seed)
     # Zipf draws are unbounded above; fold into the user range. (z - 1)
     # keeps user 0 the hottest.
@@ -107,19 +157,25 @@ def synthesize_trace(users: int,
     lat = rng.lognormal(mean=mu, sigma=lat_sigma, size=arrivals)
     # A client cannot have pulled before the trace started.
     lat = np.minimum(lat, t)
+    params = {
+        "zipf_a": zipf_a,
+        "gap_sigma": gap_sigma,
+        "lat_mean_s": lat_mean_s,
+        "lat_sigma": lat_sigma,
+    }
+    v = TRACE_SCHEMA_VERSION
+    if poison_frac > 0.0:
+        v = TRACE_SCHEMA_VERSION_POISON
+        params["poison_frac"] = float(poison_frac)
+        params["poison_scale"] = float(poison_scale)
     header = TraceHeader(
-        v=TRACE_SCHEMA_VERSION,
+        v=v,
         users=int(users),
         arrivals=int(arrivals),
         seed=int(seed),
         horizon_s=float(horizon_s),
         generator="zipf_lognormal",
-        params={
-            "zipf_a": zipf_a,
-            "gap_sigma": gap_sigma,
-            "lat_mean_s": lat_mean_s,
-            "lat_sigma": lat_sigma,
-        },
+        params=params,
     )
     return header, t, user.astype(np.int64), lat
 
@@ -129,11 +185,30 @@ def write_trace(path: str, header: TraceHeader, t: np.ndarray,
     """Write a trace file (header + one arrival line per event)."""
     if not (len(t) == len(user) == len(lat) == header.arrivals):
         raise ValueError("header.arrivals does not match array lengths")
+    # v2 adversarial traces: the attacker set is a pure function of the
+    # header, so marking happens here and the arrival arrays stay the
+    # same shape for every caller.
+    attackers: frozenset = frozenset()
+    scale = 0.0
+    if header.v == TRACE_SCHEMA_VERSION_POISON:
+        frac = float(header.params.get("poison_frac", 0.0))
+        scale = float(header.params.get("poison_scale", 0.0))
+        if frac <= 0.0 or scale <= 0.0:
+            raise ValueError("v2 trace header must carry positive "
+                             "poison_frac and poison_scale params")
+        attackers = frozenset(
+            int(u) for u in poisoned_user_ids(header.users, header.seed, frac))
     with open(path, "w", encoding="utf-8") as fh:
         fh.write(json.dumps(header.to_json(), sort_keys=True) + "\n")
         for i in range(len(t)):
-            fh.write('{"kind": "arrival", "t": %.9f, "user": %d, "lat": %.9f}\n'
-                     % (float(t[i]), int(user[i]), float(lat[i])))
+            u = int(user[i])
+            if u in attackers:
+                fh.write('{"kind": "arrival", "t": %.9f, "user": %d, '
+                         '"lat": %.9f, "poison": %.9f}\n'
+                         % (float(t[i]), u, float(lat[i]), scale))
+            else:
+                fh.write('{"kind": "arrival", "t": %.9f, "user": %d, "lat": %.9f}\n'
+                         % (float(t[i]), u, float(lat[i])))
 
 
 def read_header(fh: IO[str]) -> TraceHeader:
@@ -144,9 +219,9 @@ def read_header(fh: IO[str]) -> TraceHeader:
     if obj.get("kind") != "trace_header":
         raise ValueError("trace file does not start with a trace_header line")
     v = int(obj.get("v", -1))
-    if v != TRACE_SCHEMA_VERSION:
+    if v not in _READABLE_VERSIONS:
         raise ValueError(f"unsupported trace schema v={v} "
-                         f"(this build reads v={TRACE_SCHEMA_VERSION})")
+                         f"(this build reads v in {_READABLE_VERSIONS})")
     return TraceHeader(
         v=v,
         users=int(obj["users"]),
@@ -183,7 +258,8 @@ def read_trace(path: str) -> tuple[TraceHeader, Iterator[Arrival]]:
                     raise ValueError("trace arrivals are not sorted by t")
                 last_t = t
                 yield Arrival(t=t, user=int(obj["user"]),
-                              lat=float(obj.get("lat", 0.0)))
+                              lat=float(obj.get("lat", 0.0)),
+                              poison=float(obj.get("poison", 0.0)))
         finally:
             fh.close()
 

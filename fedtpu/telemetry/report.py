@@ -95,6 +95,9 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     autoscale_summary: Optional[dict] = None
     serve_pre_drains: List[dict] = []
     serve_configures = 0
+    screened_events = 0
+    screened_updates = 0
+    quarantines: List[dict] = []
     for e in events:
         v = e.get("v")
         if isinstance(v, int) and v > EVENT_SCHEMA_VERSION:
@@ -182,6 +185,14 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             serve_summary = {"tick": e.get("round"), **payload}
         elif kind == "async_starvation":
             starvation.append({"round": e.get("round"), **payload})
+        # Defense timeline (fedtpu.robust; docs/robustness.md): one
+        # serve_screened event per tick that screened anything, one
+        # serve_quarantine event per quarantined user id.
+        elif kind == "serve_screened":
+            screened_events += 1
+            screened_updates += int(payload.get("n_screened") or 0)
+        elif kind == "serve_quarantine":
+            quarantines.append({"tick": e.get("round"), **payload})
         # Cohort timeline (fedtpu.cohort; docs/scaling.md). The summary
         # carries the end-of-run store footprint; per-round events supply
         # the cadence and resident-bytes trajectory when a run died early.
@@ -249,6 +260,15 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             "summary": serve_summary,
             "starvation": starvation,
         }
+        if screened_events or quarantines:
+            out["serving"]["defense"] = {
+                "screened_ticks": screened_events,
+                "screened_updates": screened_updates,
+                "quarantines": quarantines,
+                "quarantined_users": sorted(
+                    {int(q["user"]) for q in quarantines
+                     if q.get("user") is not None}),
+            }
     if cohort_rounds or cohort_config or cohort_summary:
         out["cohort"] = {
             "rounds": cohort_rounds,
@@ -454,6 +474,17 @@ def render_text(agg: dict) -> str:
             lines.append(f"  K-BUFFER STARVATION @ tick {sv.get('round')}: "
                          f"{sv.get('pending')} buffered update(s) never "
                          f"reached buffer_size {sv.get('buffer_size')}")
+        defense = srv.get("defense")
+        if defense:
+            lines.append(f"  defense: {defense['screened_updates']} "
+                         f"screened update(s) over "
+                         f"{defense['screened_ticks']} tick(s), "
+                         f"{len(defense['quarantined_users'])} user(s) "
+                         f"quarantined")
+            for q in defense.get("quarantines") or []:
+                lines.append(f"    QUARANTINED user {q.get('user')} @ tick "
+                             f"{q.get('tick')} (t {q.get('t_virtual')}, "
+                             f"{q.get('strikes')} strike(s))")
     coh = agg.get("cohort")
     if coh:
         lines.append("cohort:")

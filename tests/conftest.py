@@ -139,6 +139,12 @@ QUICK_TESTS = {
     "test_robust.py::test_geometric_median_matches_numpy_weiszfeld",
     "test_robust.py::test_robust_rejects_bad_combos",
     "test_robust.py::test_weiszfeld_iteration_budget_converges",
+    "test_robust_defense.py::"
+    "test_poisoned_user_ids_is_deterministic_and_validated",
+    "test_robust_defense.py::test_trace_reader_rejects_future_schema",
+    "test_robust_defense.py::"
+    "test_defense_sim_compare_reports_first_divergence",
+    "test_robust_defense.py::test_cohort_sampler_refuses_quarantined_ids",
     "test_round_smoke.py::test_empty_hidden_sizes_is_logistic_regression",
     "test_server_opt.py::test_update_rules_match_numpy_oracle",
     "test_server_opt.py::test_clip_by_global_norm_is_per_client_joint",

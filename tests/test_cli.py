@@ -219,6 +219,7 @@ def test_every_documented_flag_exists_in_the_parser():
                 "docs/observability.md", "docs/analysis.md",
                 "docs/performance.md", "docs/resilience.md",
                 "docs/serving.md", "docs/scaling.md", "docs/autoscale.md",
+                "docs/robustness.md",
                 "PARITY.md",
                 "benchmarks/RESULTS.md"):
         text = open(os.path.join(root, rel)).read()

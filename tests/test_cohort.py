@@ -572,8 +572,13 @@ _REJECTIONS = [
      r"no server_opt / DP"),
     (dict(dp_clip_norm=1.0, dp_adaptive_clip=True), {},
      r"no server_opt / DP"),
-    (dict(robust_aggregation="trimmed_mean"), {}, r"robust\s+aggregation"),
-    (dict(byzantine_clients=2), {}, r"robust\s+aggregation"),
+    # Coordinate-wise robust rules are supported (uniform + psum only);
+    # whole-update rules and synthetic byzantine injection stay rejected.
+    (dict(robust_aggregation="trimmed_mean"), {}, r"unweighted"),
+    (dict(robust_aggregation="median", weighting="uniform",
+          aggregation="ring"), {}, r"psum backend"),
+    (dict(robust_aggregation="krum"), {}, r"vmap engine"),
+    (dict(byzantine_clients=2), {}, r"poisoned serving traces"),
     (dict(compress="8bit"), {}, r"compressed\s+exchange"),
     (dict(scaffold=True), {}, r"SCAFFOLD"),
     (dict(personalize_steps=3), {}, r"personalize_steps"),

@@ -135,8 +135,15 @@ def test_byzantine_composes_with_dp_clipping():
 def test_robust_rejects_bad_combos():
     with pytest.raises(ValueError, match="unknown robust_aggregation"):
         _setup(robust_aggregation="rfa_typo")
+    # Coordinate-wise rules are mask-aware now: median + sampling builds.
+    # Whole-update rules still need every client's vector present.
+    _setup(robust_aggregation="median", weighting="uniform",
+           participation_rate=0.5)
     with pytest.raises(ValueError, match="full participation"):
-        _setup(robust_aggregation="median", weighting="uniform",
+        _setup(robust_aggregation="krum", krum_f=2, weighting="uniform",
+               participation_rate=0.5)
+    with pytest.raises(ValueError, match="cohort robust path"):
+        _setup(robust_aggregation="geometric_median", weighting="uniform",
                participation_rate=0.5)
     with pytest.raises(ValueError, match="unweighted"):
         _setup(robust_aggregation="median")   # default data_size weighting

@@ -1,0 +1,367 @@
+"""Poisoning defense at serving scale (fedtpu.robust; docs/robustness.md).
+
+Four contracts:
+
+* **Screen precision** — honest-but-heterogeneous clients (dirichlet
+  label skew) must produce ZERO screened updates at the default
+  thresholds; a threshold sweep shows where the norm test starts to
+  bite, so the default's headroom is a measured number, not a vibe.
+* **Screen recall** — an amplified sign-flipped update is screened once
+  the rolling median is warm, and a screened arrival changes nothing
+  (the global step equals the attacker-absent step bitwise).
+* **Quarantine determinism** — strike/quarantine decisions are pure
+  functions of the virtual-time tick stream: bitwise identical across a
+  mid-campaign checkpoint/restore, and durably flagged in the client
+  store's versioned reputation field.
+* **The golden gate** — the defense sim's decision JSONL is bitwise
+  deterministic and matches the COMMITTED golden
+  (tests/goldens/defense_sim.jsonl), with divergence reported by first
+  differing line (autoscale-gate idiom).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from fedtpu.config import (ModelConfig, OptimConfig, ServingConfig,
+                           ShardConfig)
+from fedtpu.data.sharding import pack_clients
+from fedtpu.data.tabular import synthetic_income_like
+from fedtpu.models import build_model
+from fedtpu.ops import build_optimizer
+from fedtpu.robust.defense_sim import (compare_decisions, simulate,
+                                       write_decisions)
+from fedtpu.serving.traces import (TRACE_SCHEMA_VERSION,
+                                   TRACE_SCHEMA_VERSION_POISON,
+                                   load_trace_arrays, poisoned_user_ids,
+                                   read_trace, synthesize_trace,
+                                   write_trace)
+from fedtpu.telemetry.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "goldens", "defense_sim.jsonl")
+
+C = 8
+
+
+def _screen_fixtures(strategy="dirichlet"):
+    """A driven async setup over label-skewed honest shards."""
+    import jax
+
+    from fedtpu.parallel import async_fed, client_sharding, make_mesh
+    x, y = synthetic_income_like(256, 6, 2, seed=0)
+    packed = pack_clients(x, y, ShardConfig(num_clients=C, shuffle=False,
+                                            strategy=strategy,
+                                            dirichlet_alpha=0.3))
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=6,
+                                                hidden_sizes=(16, 8)))
+    tx = build_optimizer(OptimConfig())
+    mesh = make_mesh(num_clients=C)
+    batch = {k: jax.device_put(v, client_sharding(mesh)) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    return mesh, init_fn, apply_fn, tx, batch
+
+
+def _drive(mesh, init_fn, apply_fn, tx, batch, *, ticks, weights,
+           norm_mult=4.0, cos_min=-0.2, warmup=8, window=16):
+    """Run ``ticks`` driven screen ticks with per-tick arrival weight
+    rows from ``weights`` (callable tick -> (C,) array). Returns the
+    total screened count and the final state."""
+    import jax
+
+    from fedtpu.parallel import async_fed
+    state = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                       init_fn, tx, same_init=True,
+                                       screen_window=window)
+    step = async_fed.build_async_round_fn(
+        mesh, apply_fn, tx, 2, driven=True, screen=True,
+        screen_norm_mult=norm_mult, screen_cos_min=cos_min,
+        screen_warmup=warmup, screen_window=window)
+    screened = 0
+    for k in range(ticks):
+        arr = np.asarray(weights(k), np.float32)[None, :]
+        state, m = step(state, batch, arr)
+        screened += int(np.asarray(m["screened"]).sum())
+    return screened, state
+
+
+# ------------------------------------------------------- screen precision
+
+def test_label_skew_honest_clients_zero_false_positives():
+    """Satellite pin: dirichlet label-skewed HONEST clients are exactly
+    the hard case for a norm/direction screen (heterogeneous data means
+    heterogeneous update norms and directions) — at the default
+    thresholds none of them may be screened."""
+    fx = _screen_fixtures("dirichlet")
+    screened, _ = _drive(*fx, ticks=24, weights=lambda k: np.ones(C))
+    assert screened == 0
+
+
+def test_threshold_sweep_locates_the_norm_test_bite_point():
+    """Sweep screen_norm_mult downward over the same honest label-skew
+    traffic: the default never fires, a paranoid multiplier eventually
+    does, and the false-positive count is monotone as thresholds
+    tighten — the sweep that justifies the 4.0 default."""
+    fx = _screen_fixtures("dirichlet")
+    counts = {}
+    for mult in (4.0, 2.0, 1.05, 0.7):
+        counts[mult], _ = _drive(*fx, ticks=24, norm_mult=mult,
+                                 weights=lambda k: np.ones(C))
+    assert counts[4.0] == 0
+    assert counts[0.7] > 0, counts
+    ordered = [counts[m] for m in (4.0, 2.0, 1.05, 0.7)]
+    assert ordered == sorted(ordered), counts
+
+
+# --------------------------------------------------------- screen recall
+
+def test_sign_flipped_update_is_screened_once_warm():
+    """An attacker submitting a 10x sign-flipped update (arrival weight
+    -10) is screened every post-warmup tick; honest peers are not."""
+    fx = _screen_fixtures("contiguous")
+
+    def weights(k):
+        w = np.ones(C)
+        w[3] = -10.0
+        return w
+
+    import jax
+
+    from fedtpu.parallel import async_fed
+    mesh, init_fn, apply_fn, tx, batch = fx
+    state = async_fed.init_async_state(jax.random.key(0), mesh, C,
+                                       init_fn, tx, same_init=True,
+                                       screen_window=16)
+    step = async_fed.build_async_round_fn(
+        mesh, apply_fn, tx, 2, driven=True, screen=True,
+        screen_warmup=4, screen_window=16)
+    hits = []
+    for k in range(10):
+        arr = np.asarray(weights(k), np.float32)[None, :]
+        state, m = step(state, batch, arr)
+        scr = np.asarray(m["screened"])
+        # Honest clients never screened.
+        assert scr[[i for i in range(C) if i != 3]].sum() == 0
+        hits.append(float(scr[3]))
+    # Warmup passes within the first few ticks; from then on the
+    # attacker is caught every tick.
+    assert sum(hits) >= 5, hits
+    assert hits[-1] == 1.0 and hits[-2] == 1.0
+
+
+# ----------------------------------------------- quarantine determinism
+
+def _poison_rows(arrivals=260, users=30, seed=5, frac=0.2, scale=10.0):
+    header, t, user, lat = synthesize_trace(users, arrivals, 20.0,
+                                            seed=seed, poison_frac=frac,
+                                            poison_scale=scale)
+    atk = {int(u) for u in poisoned_user_ids(users, seed, frac)}
+    rows = [([int(user[i]), float(t[i]), float(lat[i]), None, scale]
+             if int(user[i]) in atk else
+             [int(user[i]), float(t[i]), float(lat[i])])
+            for i in range(len(t))]
+    return rows, sorted(atk)
+
+
+def _defense_cfg(**kw):
+    base = dict(cohort=8, buffer_size=2, tick_interval_s=0.5,
+                data_rows=64, model_hidden=(8,), seed=0, screen=True,
+                quarantine_strikes=3)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def test_quarantine_bitwise_across_checkpoint_restore(tmp_path):
+    """Mid-campaign kill+resume must not move a single defense decision:
+    run the same poisoned replay straight through and split across a
+    checkpoint/restore, and compare the decision log, strike table,
+    quarantine set, and tick history bitwise."""
+    from fedtpu.serving.engine import ServingEngine
+    rows, attackers = _poison_rows()
+    half = len(rows) // 2
+
+    a = ServingEngine(_defense_cfg(), registry=MetricsRegistry())
+    a.offer_many(rows)
+    a.drain()
+    assert a.quarantined, "campaign never quarantined anyone"
+    assert set(a.quarantined) <= set(attackers)
+
+    b1 = ServingEngine(_defense_cfg(), registry=MetricsRegistry())
+    b1.offer_many(rows[:half])
+    ckdir = str(tmp_path / "ck")
+    b1.checkpoint(ckdir)
+    b2 = ServingEngine(_defense_cfg(), registry=MetricsRegistry())
+    b2.restore(ckdir)
+    assert b2.strikes == b1.strikes
+    assert b2.quarantined == b1.quarantined
+    b2.offer_many(rows[half:])
+    b2.drain()
+
+    assert b2.quarantined == a.quarantined
+    assert b2.strikes == a.strikes
+    assert b2.screened_total == a.screened_total
+    assert b2.history_lines() == a.history_lines()
+    # The post-restore decision tail continues the uninterrupted log.
+    assert b2.defense_log == a.defense_log[len(b1.defense_log):]
+
+
+def test_quarantine_refused_at_offer_and_flagged_in_store():
+    """A quarantined user's later offers are refused without spending an
+    admission token, and the store's versioned reputation field carries
+    the flag durably (quarantined_ids round-trips it)."""
+    from fedtpu.serving.admission import SCREENED
+    from fedtpu.serving.engine import ServingEngine
+    rows, attackers = _poison_rows()
+    eng = ServingEngine(_defense_cfg(), registry=MetricsRegistry())
+    eng.attach_store(total_users=30)
+    eng.offer_many(rows)
+    eng.drain()
+    assert eng.quarantined
+    flagged = sorted(int(u) for u in eng.store.quarantined_ids())
+    assert flagged == sorted(eng.quarantined)
+    victim = next(iter(eng.quarantined))
+    before = dict(eng.admission.counts)
+    assert eng.offer(99.0, victim, 0.0) == SCREENED
+    after = dict(eng.admission.counts)
+    assert after[SCREENED] == before[SCREENED] + 1
+
+
+def test_cohort_sampler_refuses_quarantined_ids():
+    from fedtpu.cohort.scheduler import CohortSampler
+    s = CohortSampler(total_clients=10, cohort_size=4, seed=0)
+    s.refuse([1, 2])
+    for r in range(6):
+        cohort = s.sample(r)
+        assert not ({1, 2} & set(int(c) for c in cohort.ravel()))
+    with pytest.raises(ValueError, match="population exhausted"):
+        s.refuse(range(9))
+
+
+# ------------------------------------------------------------ trace v2
+
+def test_poison_free_synthesis_is_byte_identical_v1(tmp_path):
+    h1, t, u, lat = synthesize_trace(100, 60, seed=3)
+    h2, t2, u2, l2 = synthesize_trace(100, 60, seed=3, poison_frac=0.0)
+    assert h1.v == TRACE_SCHEMA_VERSION and h1.to_json() == h2.to_json()
+    p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    write_trace(p1, h1, t, u, lat)
+    write_trace(p2, h2, t2, u2, l2)
+    with open(p1, "rb") as fa, open(p2, "rb") as fb:
+        assert fa.read() == fb.read()
+
+
+def test_poisoned_trace_v2_roundtrip(tmp_path):
+    h, t, u, lat = synthesize_trace(100, 80, seed=3, poison_frac=0.2,
+                                    poison_scale=8.0)
+    assert h.v == TRACE_SCHEMA_VERSION_POISON
+    assert h.params["poison_frac"] == 0.2
+    atk = {int(x) for x in poisoned_user_ids(100, 3, 0.2)}
+    assert len(atk) == 20
+    path = str(tmp_path / "p.jsonl")
+    write_trace(path, h, t, u, lat)
+    _, events = read_trace(path)
+    for ev in events:
+        assert ev.poison == (8.0 if ev.user in atk else 0.0)
+    # The 4-tuple array loader (cohort trace sampling, autoscale sim)
+    # stays backward compatible with v2 files.
+    h3, t3, u3, l3 = load_trace_arrays(path)
+    np.testing.assert_array_equal(u3, u)
+
+
+def test_trace_reader_rejects_future_schema(tmp_path):
+    path = tmp_path / "v3.jsonl"
+    path.write_text('{"kind": "trace_header", "v": 3, "users": 1, '
+                    '"arrivals": 0}\n')
+    with pytest.raises(ValueError, match="unsupported trace schema"):
+        read_trace(str(path))
+
+
+def test_poisoned_user_ids_is_deterministic_and_validated():
+    a = poisoned_user_ids(1000, 7, 0.1)
+    b = poisoned_user_ids(1000, 7, 0.1)
+    np.testing.assert_array_equal(a, b)
+    assert len(a) == 100 and len(set(a.tolist())) == 100
+    assert poisoned_user_ids(1000, 7, 0.0).size == 0
+    with pytest.raises(ValueError, match="poison_frac"):
+        poisoned_user_ids(10, 0, 1.5)
+
+
+# ------------------------------------------------------ the golden gate
+
+def test_defense_sim_is_bitwise_deterministic():
+    a = simulate()
+    b = simulate()
+    assert a["lines"] == b["lines"]
+    assert a["summary"]["quarantined"] == b["summary"]["quarantined"]
+
+
+def test_defense_sim_matches_committed_golden():
+    """The tier-1 gate: the pinned simulation's decision log must match
+    the committed golden bitwise, and the pinned campaign must be fully
+    contained — every attacker quarantined, no honest user touched."""
+    out = simulate()
+    cmp = compare_decisions(out["lines"], GOLDEN)
+    assert cmp["ok"], cmp["reason"]
+    s = out["summary"]
+    assert s["quarantined"] == s["attackers"]
+    assert s["quarantined_honest"] == []
+    assert s["eval_accuracy"] >= 0.9
+
+
+def test_defense_sim_compare_reports_first_divergence(tmp_path):
+    path = str(tmp_path / "g.jsonl")
+    write_decisions(path, ["a", "b", "c"])
+    assert compare_decisions(["a", "b", "c"], path)["ok"]
+    div = compare_decisions(["a", "X", "c"], path)
+    assert not div["ok"] and "first divergence at line 2" in div["reason"]
+    short = compare_decisions(["a"], path)
+    assert not short["ok"] and "count 1 != golden 3" in short["reason"]
+
+
+@pytest.mark.slow
+def test_check_defense_sim_folds_golden_into_exit_code(tmp_path):
+    """`fedtpu check --defense-sim` folds the pinned golden into the
+    one-shot health verdict; a divergent golden fails it. Subprocess:
+    check pins the platform at import time."""
+    out = subprocess.run(
+        [sys.executable, "-m", "fedtpu.cli", "check", "--json",
+         "--defense-sim", GOLDEN],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    rep = json.loads(out.stdout)
+    assert rep["defense_sim"]["ok"] is True
+    assert rep["defense_sim"]["quarantined_honest"] == []
+    bad = str(tmp_path / "bad.jsonl")
+    write_decisions(bad, ["{}"])
+    out = subprocess.run(
+        [sys.executable, "-m", "fedtpu.cli", "check", "--json",
+         "--defense-sim", bad],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode != 0
+    rep = json.loads(out.stdout)
+    assert rep["defense_sim"]["ok"] is False
+
+
+@pytest.mark.slow
+def test_chaos_mp_poison_campaign_row(tmp_path):
+    """The acceptance drill: 2-gateway fleet, three passes (defended /
+    defenses-off / clean), exact attacker-set containment, accuracy
+    within tolerance of clean, zero gang restarts, and a demonstrably
+    degraded undefended run."""
+    from fedtpu.resilience.chaos import run_scenario
+    row = run_scenario("mp_poison_campaign", str(tmp_path), {}, 0, 0,
+                       "cpu", 540)
+    assert row["ok"], json.dumps(row, indent=2)
+    assert row["quarantined"] == row["attackers"]
+    assert row["quarantined_honest"] == []
+    assert row["gang_restarts"] == 0
+    assert (row["accuracy_defended"]
+            >= row["accuracy_clean"] - 0.01)
+    assert (row["accuracy_undefended"]
+            <= row["accuracy_clean"] - 0.05)

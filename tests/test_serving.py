@@ -28,7 +28,7 @@ import pytest
 from fedtpu.config import ServingConfig
 from fedtpu.serving.admission import (ACCEPT, DEPRIORITIZE,
                                       REJECT_BACKPRESSURE, REJECT_RATE,
-                                      REJECT_STALE, VERDICTS,
+                                      REJECT_STALE, SCREENED, VERDICTS,
                                       AdmissionController, AdmissionPolicy,
                                       TokenBucket)
 from fedtpu.serving.traces import (TRACE_SCHEMA_VERSION, load_trace_arrays,
@@ -71,6 +71,11 @@ def test_admission_check_order_is_rate_backpressure_staleness():
     # Between the two staleness bars -> admitted but deprioritized.
     assert ctl.decide(30.0, staleness=3, pending=0) == DEPRIORITIZE
     assert ctl.decide(40.0, staleness=0, pending=0) == ACCEPT
+    # The defense verdict never comes from decide() — it is recorded by
+    # the engine's screen/quarantine path through record().
+    assert ctl.record(SCREENED, 50.0) == SCREENED
+    with pytest.raises(ValueError, match="unknown verdict"):
+        ctl.record("bogus")
     # Every verdict was exercised and counted (both dict + registry).
     assert set(ctl.counts) == set(VERDICTS)
     assert all(n >= 1 for n in ctl.counts.values())
