@@ -10,6 +10,7 @@ Two halves:
         FTP004  Python branching on tracer values
         FTP005  bare print() outside the telemetry output layer
         FTP006  jit wrapper rebuilt per loop iteration / per call
+        FTP009  socket.socket()/create_connection() without a timeout
         FTP101  mutable default arguments
         FTP102  broad except that swallows all errors
         Suppress per line with ``# fedtpu: noqa[FTP001] <justification>``.

@@ -1,4 +1,4 @@
-"""Generic (non-JAX) rules: FTP005, FTP007, FTP101, FTP102.
+"""Generic (non-JAX) rules: FTP005, FTP007, FTP009, FTP101, FTP102.
 
 FTP005 absorbs the bare-print lint that used to live inline in
 ``tests/test_telemetry.py``: telemetry output must flow through
@@ -107,6 +107,54 @@ def check_library_exit(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
                 message=f"{name}() in library code bypasses checkpoint "
                 "drain and the supervisor exit-code contract "
                 "(docs/resilience.md); raise an exception instead",
+            )
+
+
+@rule(
+    "FTP009",
+    "socket-no-timeout",
+    "socket.socket() / create_connection() without an explicit timeout: "
+    "a blocking socket with no deadline hangs the caller forever when "
+    "the peer wedges (the failure mode the serving retry ladder and "
+    "wire-fault drills exist to survive).",
+)
+def check_socket_timeout(tree: ast.AST, src: str,
+                         path: str) -> Iterable[Finding]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_ctor = (isinstance(f, ast.Attribute)
+                   and isinstance(f.value, ast.Name)
+                   and f.value.id == "socket" and f.attr == "socket")
+        is_connect = (
+            (isinstance(f, ast.Name) and f.id == "create_connection")
+            or (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "socket"
+                and f.attr == "create_connection"))
+        if is_ctor:
+            # The constructor NEVER takes a timeout, so every call site
+            # must either settimeout()/setblocking(False) and say so in
+            # a noqa justification, or switch to create_connection.
+            yield Finding(
+                rule="FTP009",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message="socket.socket() starts blocking with no "
+                "deadline; settimeout()/selectors it and justify with "
+                "a noqa, or use socket.create_connection(..., timeout=)",
+            )
+        elif is_connect and not any(k.arg == "timeout"
+                                    for k in node.keywords):
+            yield Finding(
+                rule="FTP009",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message="create_connection() without timeout= blocks "
+                "forever on a wedged peer; pass an explicit timeout",
             )
 
 

@@ -400,6 +400,13 @@ def _add_serving_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--port-file", default=None, metavar="FILE",
                    help="write the bound port here once listening "
                         "(ephemeral-port discovery for loadgen)")
+    p.add_argument("--net-fault-plan", default=None, metavar="JSON",
+                   help="seeded wire-fault schedule (path or inline "
+                        "JSON, fedtpu.resilience.netfaults): fronts "
+                        "this server with a deterministic fault proxy "
+                        "discovered via <port-file>.net — partitions, "
+                        "torn/replayed frames, resets, slow links. "
+                        "Requires --port-file")
     p.add_argument("--cohort", type=_positive_int, default=8,
                    help="concurrent engine slots C; users get "
                         "stable slot bindings with LRU eviction "
@@ -796,6 +803,12 @@ def build_parser() -> argparse.ArgumentParser:
                               "adversarial trace) and compare its decision "
                               "log bitwise against this golden JSONL, "
                               "folded into the exit code")
+    check_p.add_argument("--net-sim", default=None, metavar="GOLDEN",
+                         help="also replay the pinned wire-fault "
+                              "campaign (NetFaultPlan through the real "
+                              "engine/session machinery) and compare "
+                              "its decision log bitwise against this "
+                              "golden JSONL, folded into the exit code")
     check_p.add_argument("--gateway-probe", default=None,
                          metavar="PORT_FILE_BASE",
                          help="also probe a live gateway fleet's health "
@@ -931,14 +944,9 @@ def build_parser() -> argparse.ArgumentParser:
                              help="execute the resilience scenario matrix "
                                   "(kill/preempt/NaN/dropout/straggler) "
                                   "and report per-scenario recovery")
+    from fedtpu.resilience.chaos import scenarios_help
     chaos_p.add_argument("--scenarios", default=None, metavar="A,B",
-                         help="comma-separated subset of: sigkill, "
-                              "preempt, nan_rollback, dropout, straggler, "
-                              "mp_kill_worker, mp_kill_coordinator, "
-                              "mp_hang, mp_preempt, mp_autoscale_preempt, "
-                              "mp_gateway_kill, mp_store_shard_kill "
-                              "(default: all; the mp_* rows run a "
-                              "2-process gang or gateway fleet)")
+                         help=scenarios_help())
     chaos_p.add_argument("--rounds", type=_positive_int, default=10,
                          help="rounds per scenario run (default 10)")
     chaos_p.add_argument("--num-clients", type=_positive_int, default=4,
@@ -1514,6 +1522,25 @@ def main(argv=None) -> int:
                 "quarantined_honest": sim["summary"]["quarantined_honest"],
                 "eval_accuracy": sim["summary"]["eval_accuracy"]}
             report["ok"] = report["ok"] and cmp["ok"]
+        if args.net_sim:
+            # Fold the pinned wire-fault campaign into the check: the
+            # frame-by-frame decision log (fault verdicts, retries,
+            # duplicate acks) must match the committed golden bitwise —
+            # drift anywhere in the exactly-once chain (schedule
+            # materialization, session dedup, ack shape) fails the gate.
+            from fedtpu.resilience.net_sim import (compare_decisions as
+                                                   _cmp_net)
+            from fedtpu.resilience.net_sim import simulate as _sim_net
+            sim = _sim_net()
+            cmp = _cmp_net(sim["lines"], args.net_sim)
+            report["net_sim"] = {
+                "ok": cmp["ok"], "reason": cmp["reason"],
+                "golden": args.net_sim,
+                "wire_frames": sim["summary"]["wire_frames"],
+                "incorporated": sim["summary"]["incorporated"],
+                "duplicate_drops": sim["summary"]["duplicate_drops"],
+                "lost_acked": sim["summary"]["lost_acked"]}
+            report["ok"] = report["ok"] and cmp["ok"]
         if args.gateway_probe:
             # Fold a live fleet health probe into the check: every member
             # must answer a stats round-trip on its derived port file.
@@ -1543,6 +1570,13 @@ def main(argv=None) -> int:
                       f"quarantined={d['quarantined']} "
                       f"honest={d['quarantined_honest']} "
                       f"accuracy={d['eval_accuracy']:.4f}")
+            if "net_sim" in report:
+                n = report["net_sim"]
+                print(f"net-sim: ok={n['ok']} ({n['reason']}) "
+                      f"frames={n['wire_frames']} "
+                      f"incorporated={n['incorporated']} "
+                      f"dups={n['duplicate_drops']} "
+                      f"lost_acked={n['lost_acked']}")
             if "gateway_probe" in report:
                 for r in report["gateway_probe"]:
                     state = ("up" if r["ok"]
@@ -1616,7 +1650,8 @@ def main(argv=None) -> int:
                 checkpoint_every_ticks=args.checkpoint_every_ticks,
                 port_file=args.port_file, history_path=args.history,
                 heartbeat=args.heartbeat, once=args.once,
-                resume=args.resume, verbose=not args.quiet)
+                resume=args.resume, verbose=not args.quiet,
+                net_fault_plan=args.net_fault_plan)
         except Preempted as p:
             # SIGTERM drain completed: serving state (engine + pending
             # queue + history) is checkpointed; the supervisor contract's
@@ -1663,7 +1698,8 @@ def main(argv=None) -> int:
                 total_users=args.total_users,
                 store_backend=args.store, store_path=args.store_path,
                 once=args.once, resume=args.resume,
-                verbose=not args.quiet)
+                verbose=not args.quiet,
+                net_fault_plan=args.net_fault_plan)
         except Preempted as p:
             if args.json:
                 print(json.dumps({"preempted": True, "tick": p.round}))

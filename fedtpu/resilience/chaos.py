@@ -143,26 +143,80 @@ import sys
 import tempfile
 from typing import List, Optional, Sequence
 
-SCENARIOS = ("sigkill", "preempt", "nan_rollback", "dropout", "straggler",
-             "mp_kill_worker", "mp_kill_coordinator", "mp_hang",
-             "mp_preempt", "mp_shrink", "mp_grow", "mp_shrink_dead",
-             "mp_autoscale_preempt", "mp_gateway_kill",
-             "mp_store_shard_kill", "mp_poison_campaign")
+# THE scenario registry: every chaos row declares its name, its family
+# tags, and its one-line help HERE, once. ``SCENARIOS``, the per-family
+# tuples below, run_chaos's verbose detail lines, and the CLI's
+# ``--scenarios`` help text all derive from this table, so a new row
+# cannot exist without appearing in all of them (tests/test_netfaults.py
+# pins the derivations against the module tuples). Family tags:
+# ``mp`` (training gang), ``reshard`` (elastic subset of mp),
+# ``autoscale``, ``gateway`` (ingestion fleet), ``poison``, ``net``
+# (wire faults); the single-process rows carry no tag.
+SCENARIO_REGISTRY = (
+    ("sigkill", (), "SIGKILL mid-round; supervisor restarts, replay"),
+    ("preempt", (), "SIGTERM drain; checkpoint + exit 75, resume"),
+    ("nan_rollback", (), "NaN divergence; rollback to last good round"),
+    ("dropout", (), "client dropout round; exact zero-weight exclusion"),
+    ("straggler", (), "slow client; lockstep timing-only perturbation"),
+    ("mp_kill_worker", ("mp",), "gang worker SIGKILL; gang restart"),
+    ("mp_kill_coordinator", ("mp",), "gang coordinator SIGKILL"),
+    ("mp_hang", ("mp",), "collective wedge; watchdog abort + restart"),
+    ("mp_preempt", ("mp",), "gang-wide SIGTERM; drain + gang resume"),
+    ("mp_shrink", ("mp", "reshard"), "preempt notice; live shrink"),
+    ("mp_grow", ("mp", "reshard"), "notice canceled; live grow-back"),
+    ("mp_shrink_dead", ("mp", "reshard"),
+     "shrink then departed process dies; no restart owed"),
+    ("mp_autoscale_preempt", ("autoscale",),
+     "serve + gang + live autoscaler through a preemption"),
+    ("mp_gateway_kill", ("gateway",),
+     "gateway SIGKILL mid-ingest; WAL/session exactly-once"),
+    ("mp_store_shard_kill", ("gateway",),
+     "store shard failover; flush/adopt, run-twice bitwise bar"),
+    ("mp_poison_campaign", ("poison",),
+     "poisoning campaign; quarantine containment vs clean run"),
+    ("mp_net_partition", ("net",),
+     "wire partition window + replayed frame; retry through blackhole"),
+    ("mp_slow_gateway", ("net",),
+     "bandwidth/latency caps + torn ack; paced link, dedup on retry"),
+    ("mp_torn_frame", ("net",),
+     "frames torn both sides of the WAL/ack boundary + mid-batch RST"),
+)
+
+
+def _family(tag: str) -> tuple:
+    return tuple(n for n, fams, _ in SCENARIO_REGISTRY if tag in fams)
+
+
+def scenarios_help() -> str:
+    """The ``--scenarios`` help text, grouped by family — derived from
+    the registry so help can never omit a row (it once did)."""
+    groups = [("single-process", tuple(n for n, fams, _ in SCENARIO_REGISTRY
+                                       if not fams))]
+    for tag, label in (("mp", "MP gang"), ("reshard", "RESHARD subset"),
+                       ("autoscale", "AUTOSCALE"), ("gateway", "GATEWAY"),
+                       ("poison", "POISON"), ("net", "NET wire")):
+        groups.append((label, _family(tag)))
+    parts = [f"{label}: {', '.join(names)}" for label, names in groups
+             if names]
+    return ("comma-separated subset to run. " + "; ".join(parts)
+            + ". Default: all")
+
+
+SCENARIOS = tuple(n for n, _, _ in SCENARIO_REGISTRY)
 
 # The gang rows: 2 OS processes x 2 virtual CPU devices each, wired into
 # one jax.distributed runtime by `supervise --num-processes 2`. Their
 # baseline is a separate uninterrupted GANG run (reduction order differs
 # across device counts, so the single-process baseline is not the right
 # bitwise reference).
-MP_SCENARIOS = ("mp_kill_worker", "mp_kill_coordinator", "mp_hang",
-                "mp_preempt", "mp_shrink", "mp_grow", "mp_shrink_dead")
+MP_SCENARIOS = _family("mp")
 # The elastic subset: a preemption NOTICE instead of a kill — the gang
 # must resize itself live (fedtpu.resilience.reshard), not restart.
-RESHARD_SCENARIOS = ("mp_shrink", "mp_grow", "mp_shrink_dead")
+RESHARD_SCENARIOS = _family("reshard")
 # The control-plane drill: serve + gang + live `fedtpu autoscale` side
 # by side. Not in MP_SCENARIOS — it needs no gang baseline (no bitwise
 # history bar: the shrink round depends on wall-clock signal timing).
-AUTOSCALE_SCENARIO = "mp_autoscale_preempt"
+AUTOSCALE_SCENARIO = _family("autoscale")[0]
 # SLO-burn ceiling for the drill's final server stats: burn 1.0 means
 # the error budget was consumed exactly as provisioned; the drill
 # deliberately overloads + preempts, so it gets double budget.
@@ -170,11 +224,23 @@ AUTOSCALE_BURN_BUDGET = 2.0
 # The ingestion-tier rows: a 2-gateway fleet instead of a training gang.
 # Like the autoscale drill they need no gang baseline (no run-loop
 # history; the shard row carries its own bitwise bar by running twice).
-GATEWAY_SCENARIOS = ("mp_gateway_kill", "mp_store_shard_kill")
+GATEWAY_SCENARIOS = _family("gateway")
 # mp_gateway_kill's SLO ceiling: a gateway death + gang restart stalls
 # incorporation for the whole restart window, so the tier's burn budget
 # sits above the autoscale drill's.
 GATEWAY_BURN_BUDGET = 2.5
+# The wire-fault rows (fedtpu.resilience.netfaults / serving.netproxy):
+# a 2-gateway fleet fronted by deterministic fault proxies — no process
+# dies, the WIRE does. Bars: zero lost acked updates, duplicate
+# drops > 0 (the ack-boundary faults actually bit), backlog drained,
+# ZERO gang restarts (wire chaos must never look like process death to
+# the supervisor), SLO burn under budget, and the whole pass runs twice
+# with byte-identical fault schedule + proxy decision logs.
+NET_SCENARIOS = _family("net")
+# No process restarts to amortize, but retry backoff stalls ingestion
+# while a partition window burns through — same ceiling as the gateway
+# tier.
+NET_BURN_BUDGET = 2.5
 # The poisoning-containment row (fedtpu.robust; docs/robustness.md): a
 # 2-gateway fleet under the gang supervisor, replayed THREE times over
 # the same arrival process — defended + poisoned, defenses-off +
@@ -184,7 +250,7 @@ GATEWAY_BURN_BUDGET = 2.5
 # within POISON_ACCURACY_TOL of the clean baseline, zero gang restarts
 # (containment must not cost availability), and the defenses-off run
 # demonstrably degraded (the fault actually bites).
-POISON_SCENARIO = "mp_poison_campaign"
+POISON_SCENARIO = _family("poison")[0]
 POISON_USERS = 40
 POISON_ARRIVALS = 900
 POISON_HORIZON_S = 30.0
@@ -722,6 +788,193 @@ def _run_store_shard_kill(workdir: str, platform: str,
     return row
 
 
+# The pinned wire campaigns, one per NET row. Frame ordinals count every
+# frame a gateway's proxy sees — hellos, retries, drains included — so
+# they are chosen against the loadgen shape below (2000 events, batch
+# 512 -> 4 updates frames per gateway after the initial hello). Every
+# row carries at least one ack-boundary fault (post_ack tear or replay)
+# so the duplicate-drops bar is meaningful on all three.
+_NET_PLANS = {
+    "mp_net_partition": {"seed": 21, "faults": [
+        # Blackhole gateway 1 for 3 frames mid-load (the 2nd updates
+        # frame plus the reconnect hellos that burn through the window)
+        # and replay a committed frame on gateway 0.
+        {"kind": "net_partition", "gateway": 1, "frame": 3, "frames": 3},
+        {"kind": "net_dup_frame", "gateway": 0, "frame": 3},
+    ]},
+    "mp_slow_gateway": {"seed": 22, "faults": [
+        # Pace gateway 0's link for 3 frames; tear gateway 1's ack AFTER
+        # the WAL/ack boundary so the retry must dedup.
+        {"kind": "net_slow_link", "gateway": 0, "frame": 2, "frames": 3,
+         "chunk_bytes": 512, "delay_s": 0.005},
+        {"kind": "net_torn_frame", "gateway": 1, "frame": 3,
+         "boundary": "post_ack", "cut_bytes": 64},
+    ]},
+    "mp_torn_frame": {"seed": 23, "faults": [
+        # Both sides of the boundary on gateway 1, a mid-batch RST and a
+        # replayed frame on gateway 0.
+        {"kind": "net_torn_frame", "gateway": 1, "frame": 2,
+         "boundary": "pre_ack", "cut_bytes": 80},
+        {"kind": "net_torn_frame", "gateway": 1, "frame": 6,
+         "boundary": "post_ack", "cut_bytes": 80},
+        {"kind": "net_reset", "gateway": 0, "frame": 3, "phase": "mid"},
+        {"kind": "net_dup_frame", "gateway": 0, "frame": 5},
+    ]},
+}
+
+
+def _net_pass(passdir: str, plan_json: str, trace: str, platform: str,
+              timeout: int) -> dict:
+    """One NET-row pass: 2-gateway fleet under the gang supervisor, each
+    member fronted by its wire-fault proxy, the loadgen retrying through
+    the chaos wire. Returns the verdict ingredients plus the
+    concatenated proxy decision logs (the bitwise artifact)."""
+    import signal as _signal
+
+    from fedtpu.serving.admission import ADMITTED
+    os.makedirs(passdir, exist_ok=True)
+    port_base = os.path.join(passdir, "port")
+    ck = os.path.join(passdir, "ck")
+    hb = os.path.join(passdir, "hb")
+    sup_events = os.path.join(passdir, "sup.events.jsonl")
+    serve_events = os.path.join(passdir, "serve.events.jsonl")
+    out = {"ok": False, "rc": -1, "retried": 0, "reconnects": 0,
+           "duplicate_drops": 0, "lost_acked": None, "backlog": None,
+           "slo_burn": None, "restarts": 0, "gang_restarts": 0,
+           "net_faults": 0, "netlog": b""}
+    sup = None
+    stderr_parts = []
+    try:
+        sup = subprocess.Popen(
+            [sys.executable, "-m", "fedtpu.cli", "supervise",
+             "--heartbeat", hb, "--num-processes", "2",
+             "--max-restarts", "2", "--grace", "10",
+             "--events", sup_events, "--",
+             "gateway", "--platform", platform, "--num-gateways", "2",
+             "--port-file", port_base, "--checkpoint-dir", ck,
+             "--net-fault-plan", plan_json,
+             "--events", serve_events, "--quiet"],
+            env=_child_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        load = subprocess.run(
+            [sys.executable, "-m", "fedtpu.cli", "loadgen", trace,
+             "--port-file", port_base, "--num-gateways", "2",
+             "--batch", "512", "--retries", "12",
+             "--retry-backoff", "0.05", "--quiet", "--json"],
+            env=_child_env(), capture_output=True, text=True,
+            timeout=timeout)
+        out["rc"] = load.returncode
+        if load.returncode != 0:
+            out["error"] = "loadgen failed"
+            stderr_parts.append(load.stderr or "")
+            return out
+        summary = json.loads(load.stdout.strip().splitlines()[-1])
+        out["retried"] = int(summary.get("retried") or 0)
+        out["reconnects"] = int(summary.get("reconnects") or 0)
+        per = summary.get("server_stats") or {}
+        stats = [s for s in per.values() if s is not None]
+        sigs = [s.get("signals") or {} for s in stats]
+        out["duplicate_drops"] = sum(
+            int(s.get("duplicate_drops") or 0) for s in stats)
+        client_admitted = sum(
+            int(n) for v, n in (summary.get("admission") or {}).items()
+            if v in ADMITTED)
+        out["client_admitted"] = client_admitted
+        out["fleet_admitted"] = sum(int(s.get("admitted") or 0)
+                                    for s in sigs)
+        fleet_incorporated = sum(int(s.get("incorporated") or 0)
+                                 for s in sigs)
+        out["backlog"] = sum(int(s.get("backlog") or 0) for s in sigs)
+        out["lost_acked"] = client_admitted - fleet_incorporated
+        burns = [s.get("slo_burn") for s in sigs
+                 if s.get("slo_burn") is not None]
+        out["slo_burn"] = max(burns) if burns else None
+
+        sup.send_signal(_signal.SIGTERM)
+        sup_rc = sup.wait(timeout=timeout)
+        res = _resilience(sup_events)
+        out["restarts"] = res.get("restarts") or 0
+        out["gang_restarts"] = res.get("gang_restarts") or 0
+        # The bitwise artifact: every proxy's decision log, in gateway
+        # order (schedule header + firings + deterministic summary).
+        chunks = []
+        for i in range(2):
+            log_path = f"{port_base}.g{i}.netlog"
+            with open(log_path, "rb") as fh:
+                chunks.append(fh.read())
+        out["netlog"] = b"".join(chunks)
+        out["net_faults"] = sum(
+            1 for line in out["netlog"].splitlines()
+            if b'"fault"' in line)
+        out["ok"] = sup_rc in (0, 75) and len(stats) == 2
+        if not out["ok"]:
+            stderr_parts.append((sup.stderr.read() or "")
+                                if sup.stderr else "")
+        return out
+    except (subprocess.TimeoutExpired, OSError, ConnectionError,
+            ValueError) as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+    finally:
+        if sup is not None and sup.poll() is None:
+            sup.kill()
+            sup.wait(timeout=30)
+        if stderr_parts:
+            out["stderr_tail"] = "\n".join(stderr_parts)[-2000:]
+
+
+def _run_net_row(name: str, workdir: str, platform: str,
+                 timeout: int) -> dict:
+    """One wire-chaos row (module docstring / NET_SCENARIOS): the whole
+    pass runs TWICE with the same pinned plan and the proxy decision
+    logs must match bitwise — the determinism verdict for the wire
+    itself. Bars: zero lost acked updates, duplicate drops > 0, backlog
+    drained, ZERO gang restarts, SLO burn under NET_BURN_BUDGET."""
+    from fedtpu.serving.traces import synthesize_trace, write_trace
+    plan_json = json.dumps(_NET_PLANS[name], sort_keys=True)
+    trace = os.path.join(workdir, f"{name}.trace.jsonl")
+    header, t, user, lat = synthesize_trace(200, 2000, 20.0, seed=11)
+    write_trace(trace, header, t, user, lat)
+
+    row = _gateway_row(name)
+    row.update({"retried": 0, "reconnects": 0, "duplicate_drops": 0,
+                "lost_acked": None, "backlog": None, "slo_burn": None,
+                "net_faults": 0, "netlog_match": False})
+    passes = []
+    for tag in ("a", "b"):
+        p = _net_pass(os.path.join(workdir, f"{name}.{tag}"),
+                      plan_json, trace, platform, timeout // 2)
+        passes.append(p)
+        if not p["ok"]:
+            row["error"] = p.get("error", "pass failed")
+            if "stderr_tail" in p:
+                row["stderr_tail"] = p["stderr_tail"]
+            break
+    a = passes[0]
+    row["rc"] = a["rc"]
+    for k in ("retried", "reconnects", "duplicate_drops", "lost_acked",
+              "backlog", "slo_burn", "net_faults"):
+        row[k] = a[k]
+    row["restarts"] = a["restarts"]
+    row["gang_restarts"] = a["gang_restarts"]
+    row["faults"] = a["net_faults"]
+    row["survived"] = all(p["ok"] for p in passes)
+    row["netlog_match"] = (len(passes) == 2 and bool(a["netlog"])
+                           and a["netlog"] == passes[1]["netlog"])
+    row["history_match"] = row["netlog_match"]
+    row["ok"] = (row["survived"]
+                 and row["netlog_match"]
+                 and row["retried"] >= 1
+                 and row["duplicate_drops"] >= 1
+                 and row["lost_acked"] == 0
+                 and a.get("client_admitted") == a.get("fleet_admitted")
+                 and row["backlog"] == 0
+                 and row["gang_restarts"] == 0
+                 and row["slo_burn"] is not None
+                 and row["slo_burn"] <= NET_BURN_BUDGET)
+    return row
+
+
 def _poison_pass(passdir: str, trace: str, screen: bool, platform: str,
                  timeout: int) -> dict:
     """One mp_poison_campaign pass: a 2-gateway fleet under the gang
@@ -857,6 +1110,8 @@ def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
     """One scenario run + verdict row (see module docstring for bars)."""
     if name == "mp_gateway_kill":
         return _run_gateway_kill(workdir, platform, timeout)
+    if name in NET_SCENARIOS:
+        return _run_net_row(name, workdir, platform, timeout)
     if name == POISON_SCENARIO:
         return _run_poison_campaign(workdir, platform, timeout)
     if name == "mp_store_shard_kill":
@@ -986,11 +1241,11 @@ def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
     os.makedirs(wd, exist_ok=True)
     try:
         baseline: dict = {}
-        if any(n not in GATEWAY_SCENARIOS and n != POISON_SCENARIO
-               for n in names):
-            # The gateway and poisoning rows carry their own baselines
-            # inside the scenario; only training rows need the
-            # uninterrupted single-process run.
+        if any(n not in GATEWAY_SCENARIOS and n not in NET_SCENARIOS
+               and n != POISON_SCENARIO for n in names):
+            # The gateway, wire-fault, and poisoning rows carry their
+            # own baselines inside the scenario; only training rows need
+            # the uninterrupted single-process run.
             if verbose:
                 print(f"[chaos] baseline run ({rounds} rounds, "
                       f"{num_clients} clients) in {wd}")
@@ -1072,6 +1327,13 @@ def run_chaos(scenarios: Optional[Sequence[str]] = None, rounds: int = 10,
                              f"replayed={row['replayed']} "
                              f"adopted_rows={row['adopted_rows']} "
                              f"lost_updates={row['lost_updates']}")
+                if name in NET_SCENARIOS:
+                    gang += (f" net_faults={row['net_faults']} "
+                             f"retried={row['retried']} "
+                             f"duplicate_drops={row['duplicate_drops']} "
+                             f"lost_acked={row['lost_acked']} "
+                             f"netlog_match={row['netlog_match']} "
+                             f"slo_burn={row['slo_burn']}")
                 if name == POISON_SCENARIO:
                     gang += (f" quarantined={row['quarantined']} "
                              f"honest={row['quarantined_honest']} "

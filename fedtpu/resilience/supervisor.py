@@ -306,7 +306,7 @@ def _free_port() -> int:
     not-yet-reaped child still holding it), and jax.distributed's
     coordination service cannot rebind it — reusing the port would make
     every coordinator-death restart flaky."""
-    with socket.socket() as s:
+    with socket.socket() as s:  # fedtpu: noqa[FTP009] bind-only port probe, never blocks on I/O
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 

@@ -30,6 +30,7 @@ Backend-free: stdlib only (the loadgen never touches jax).
 
 from __future__ import annotations
 
+import os
 import random
 import time
 import uuid
@@ -105,7 +106,16 @@ class GatewayClient:
 
     def stamped(self, obj: dict) -> dict:
         """``obj`` plus this session's idempotency stamp. Stamp ONCE per
-        logical frame, before any retries — resends reuse the seq."""
+        logical frame, before any retries — a connection reset between
+        frame send and ack recv (the lost-ack window a ``net_torn_frame``
+        at the post-ack boundary injects) is retryable precisely because
+        the resend carries the SAME seq, so the server's session table
+        answers the original verdict instead of incorporating twice.
+        Re-stamping an already-stamped frame would forge a "new" frame
+        out of a retry and break exactly-once, so it is refused here."""
+        if "seq" in obj or "nonce" in obj:
+            raise ValueError("frame already carries an idempotency stamp; "
+                             "retries must resend it, never re-stamp")
         return dict(obj, nonce=self.nonce, seq=self.next_seq())
 
     # -- connections ---------------------------------------------------
@@ -116,6 +126,18 @@ class GatewayClient:
             return self.port_file
         return protocol.gateway_port_file(self.port_file, gateway)
 
+    @staticmethod
+    def _prefer_proxy(path: str) -> str:
+        """Route through the wire-fault proxy when one fronts this
+        gateway (``<path>.net`` exists). Only meaningful AFTER the real
+        port file at ``path`` exists: the server writes ``.net`` before
+        its real port file, so that ordering is what makes the
+        preference race-free. The chaos wire is opt-in server-side and
+        transparent here: loadgen and the LiveController inherit it
+        through this one hook."""
+        proxied = protocol.net_proxy_port_file(path)
+        return proxied if os.path.exists(proxied) else path
+
     def _connect(self, gateway: int) -> protocol.Connection:
         conn = self._conns.get(gateway)
         if conn is not None:
@@ -124,10 +146,19 @@ class GatewayClient:
         path = self._path_for(gateway)
         if path is not None:
             # Re-read every time: a restarted gateway rewrites the file
-            # with its fresh ephemeral port.
+            # with its fresh ephemeral port. Wait on the REAL port file
+            # first — it is the server-ready signal, and the proxy's
+            # ``.net`` file is guaranteed to be written BEFORE it, so
+            # only after the real file exists is the proxy preference
+            # race-free (probing ``.net`` while the server is still
+            # starting would commit to the direct path and route chaos
+            # traffic around a proxy that appears a moment later).
             from fedtpu.serving.loadgen import read_port_file
             try:
                 port = read_port_file(path, timeout=_PORT_POLL_S)
+                proxied = self._prefer_proxy(path)
+                if proxied != path:
+                    port = read_port_file(proxied, timeout=_PORT_POLL_S)
             except TimeoutError as e:
                 raise ConnectionError(str(e)) from e
         if port is None:

@@ -219,13 +219,18 @@ def run_gateway(cfg, *, gateway_index: Optional[int] = None,
                 total_users: int = 0, store_backend: str = "memory",
                 store_path: Optional[str] = None,
                 once: bool = False, resume: bool = False,
-                verbose: bool = True) -> dict:
+                verbose: bool = True, net_fault_plan=None) -> dict:
     """Run ONE member of an N-gateway fleet (launch N of these under
     ``fedtpu supervise --gang``). ``gateway_index`` defaults to the
     gang's FEDTPU_PROCESS_ID; all shared paths (``port_file``,
     ``events``, ``history_path``, ``store_path``, ``heartbeat``,
     ``checkpoint_dir``) are BASE paths every member derives its own
-    file/subdir from, so the whole fleet shares one command line."""
+    file/subdir from, so the whole fleet shares one command line.
+
+    ``net_fault_plan`` (fleet-wide NetFaultPlan spec; same value on
+    every member's command line) fronts this member with a wire-fault
+    proxy on ``<port_file>.g<i>.net`` enforcing only the plan entries
+    whose ``gateway`` matches ``i`` — see fedtpu.serving.netproxy."""
     from fedtpu.resilience.distributed import (ENV_LAUNCH_ID,
                                                ENV_PROCESS_ID,
                                                heartbeat_path_for)
@@ -293,7 +298,9 @@ def run_gateway(cfg, *, gateway_index: Optional[int] = None,
         once=once, resume=resume, verbose=verbose,
         handle=_handle_frame, on_engine=_on_engine,
         start_extra={"gateway": i, "num_gateways": n,
-                     "generation": generation})
+                     "generation": generation},
+        net_fault_plan=net_fault_plan, net_gateway_index=i,
+        net_num_gateways=n)
 
 
 def probe_fleet(port_file: str, num_gateways: int,

@@ -67,32 +67,80 @@ PROTOCOL_VERSION = 1
 # survives): bounds per-frame memory on the server regardless of client.
 MAX_BATCH_EVENTS = 65536
 
-# A line longer than this is a protocol violation (connection dropped) —
-# prevents one bad client growing the recv buffer without bound.
+# A line longer than this is a protocol violation — prevents one bad
+# client growing the recv buffer without bound. With a plain bytearray
+# buffer the connection is dropped (ConnectionError); with a LineBuffer
+# the oversized line is refused AT the cap in a streaming way (yield
+# None, discard until the next newline) and the connection survives per
+# the error-frame contract — the server uses the latter so a loadgen
+# mid-replay does not lose its socket to one runaway frame.
 MAX_LINE_BYTES = 8 * 1024 * 1024
+
+
+class LineBuffer(bytearray):
+    """Recv buffer that survives oversized lines.
+
+    ``discarding`` marks that the tail of a refused line is still in
+    flight: recv_lines swallows bytes until the terminating newline
+    without buffering them, so memory stays bounded by
+    ``MAX_LINE_BYTES`` + one recv chunk no matter how the peer segments
+    the line. ``dropped`` counts refused lines for telemetry.
+    """
+
+    def __init__(self, *a):
+        super().__init__(*a)
+        self.discarding = False
+        self.dropped = 0
 
 
 def send_msg(sock: socket.socket, obj: dict) -> None:
     sock.sendall(json.dumps(obj, separators=(",", ":")).encode() + b"\n")
 
 
-def recv_lines(sock: socket.socket, buf: bytearray) -> Iterator[bytes]:
+def recv_lines(sock: socket.socket, buf: bytearray) -> Iterator[Optional[bytes]]:
     """Yield complete lines accumulated in ``buf`` from one recv().
 
     Returns without yielding when no full line arrived yet; raises
-    ``ConnectionError`` on EOF or an oversized line. ``buf`` carries the
-    partial tail between calls.
+    ``ConnectionError`` on EOF. ``buf`` carries the partial tail between
+    calls. A line exceeding ``MAX_LINE_BYTES`` — whether it arrived in
+    one chunk or in many small TCP segments — is refused the moment the
+    cap is crossed: with a ``LineBuffer`` the refusal is yielded as
+    ``None`` (caller answers an error frame, connection survives) and
+    the line's remaining bytes are discarded as they stream in; with a
+    plain ``bytearray`` the legacy contract holds and ``ConnectionError``
+    is raised.
     """
     chunk = sock.recv(1 << 16)
     if not chunk:
         raise ConnectionError("peer closed")
     buf += chunk
-    if len(buf) > MAX_LINE_BYTES and b"\n" not in buf:
-        raise ConnectionError("line exceeds MAX_LINE_BYTES")
     while True:
+        if getattr(buf, "discarding", False):
+            nl = buf.find(b"\n")
+            if nl < 0:
+                del buf[:]            # mid-refused-line: drop, stay bounded
+                return
+            del buf[:nl + 1]
+            buf.discarding = False
+            continue
         nl = buf.find(b"\n")
         if nl < 0:
+            if len(buf) > MAX_LINE_BYTES:
+                if not isinstance(buf, LineBuffer):
+                    raise ConnectionError("line exceeds MAX_LINE_BYTES")
+                buf.discarding = True
+                buf.dropped += 1
+                del buf[:]
+                yield None            # the cap refusal, exactly once
+                continue
             return
+        if isinstance(buf, LineBuffer) and nl > MAX_LINE_BYTES:
+            # Oversized but already complete in the buffer (cap crossed
+            # and terminated inside one recv chunk's worth of tail).
+            del buf[:nl + 1]
+            buf.dropped += 1
+            yield None
+            continue
         line = bytes(buf[:nl])
         del buf[:nl + 1]
         if line:
@@ -119,6 +167,17 @@ def gateway_port_file(base: str, index: int) -> str:
     so a redirect frame's owner is discoverable from the base path
     alone."""
     return f"{base}.g{int(index)}"
+
+
+def net_proxy_port_file(path: str) -> str:
+    """Port-file path of the wire-fault proxy fronting the server whose
+    own port file is ``path`` (``<path>.net``). When a ``--net-fault-plan``
+    is active the server writes this file BEFORE its real one, so any
+    client that discovered the real port file can atomically prefer the
+    proxy — that single derivation rule is how loadgen, GatewayClient,
+    and the LiveController all route through the chaos wire without
+    flags of their own (see fedtpu.serving.netproxy)."""
+    return f"{path}.net"
 
 
 class Connection:

@@ -51,11 +51,14 @@ _CONN_TIMEOUT_S = 30.0
 
 
 class _Conn:
-    """Per-connection recv buffer."""
+    """Per-connection recv buffer. A LineBuffer, not a plain bytearray:
+    an oversized line is refused at the cap with an error frame and the
+    connection survives (the error-frame contract), instead of the legacy
+    drop — see protocol.recv_lines."""
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
-        self.buf = bytearray()
+        self.buf = protocol.LineBuffer()
 
 
 def _handle(engine: ServingEngine, msg: dict) -> dict:
@@ -170,7 +173,9 @@ def run_server(cfg, *, events: Optional[str] = None,
                heartbeat: Optional[str] = None,
                once: bool = False, resume: bool = False,
                verbose: bool = True, handle=None, on_engine=None,
-               start_extra: Optional[dict] = None) -> dict:
+               start_extra: Optional[dict] = None,
+               net_fault_plan=None, net_gateway_index: int = 0,
+               net_num_gateways: int = 1) -> dict:
     """Serve until SIGTERM (raises ``Preempted`` after the drain) or,
     with ``once=True``, until the first accepted connection closes
     (clean drain, returns the summary). ``cfg`` is a ServingConfig.
@@ -186,6 +191,14 @@ def run_server(cfg, *, events: Optional[str] = None,
     wiring), and ``start_extra`` merges extra identity fields into the
     ``serve_start`` event (e.g. the gateway index fedtpu report groups
     the merged fleet view by).
+
+    ``net_fault_plan`` (a NetFaultPlan spec: path / inline JSON / dict)
+    puts a deterministic wire-fault proxy (fedtpu.serving.netproxy) in
+    front of this server: the proxy's port file (``<port_file>.net``) is
+    written BEFORE the real one, so any client that can discover the
+    server's port file atomically routes through the proxy. Requires
+    ``port_file``. ``net_gateway_index`` selects which gateway's entries
+    of the fleet-wide plan this proxy enforces.
     """
     from fedtpu.resilience.supervisor import Preempted, write_heartbeat
     from fedtpu.telemetry import make_tracer
@@ -225,12 +238,30 @@ def run_server(cfg, *, events: Optional[str] = None,
         for s in (signal.SIGTERM, signal.SIGINT):
             restore_sig.append((s, signal.signal(s, _on_sig)))
 
-    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock = socket.socket(  # fedtpu: noqa[FTP009] nonblocking listener under the selectors loop below
+        socket.AF_INET, socket.SOCK_STREAM)
     lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     lsock.bind((cfg.host, cfg.port))
     lsock.listen(16)
     lsock.setblocking(False)
     port = lsock.getsockname()[1]
+    proxy = None
+    if net_fault_plan is not None:
+        if not port_file:
+            raise ValueError("--net-fault-plan requires --port-file (the "
+                             "proxy is discovered via <port_file>.net)")
+        from fedtpu.serving.netproxy import start_proxy
+        # Started BEFORE the real port file exists: a client that can
+        # read our port file is guaranteed to also see the proxy's.
+        proxy = start_proxy(net_fault_plan, net_gateway_index,
+                            net_num_gateways, port, port_file,
+                            host=cfg.host)
+        if verbose:
+            log.info(f"net fault proxy on {cfg.host}:{proxy.port} "
+                     f"(gateway {net_gateway_index}, "
+                     f"schedule {proxy.plan.digest}, "
+                     f"{len(proxy.plan.for_gateway(net_gateway_index))} "
+                     "fault(s))")
     if port_file:
         tmp = f"{port_file}.tmp.{os.getpid()}"
         with open(tmp, "w") as fh:
@@ -255,6 +286,11 @@ def run_server(cfg, *, events: Optional[str] = None,
             engine.write_history(history_path)
         if checkpoint_dir:
             engine.checkpoint(checkpoint_dir)
+        if proxy is not None:
+            # Main thread hands the proxy's buffered fault records to
+            # the tracer (single-writer events file) and writes the
+            # bitwise-compared decision log (*.netlog).
+            proxy.finish(tracer)
         tracer.event("serve_stop", round=engine.tick_count, reason=reason)
         if reason == "preempted":
             tracer.event("preempted", round=engine.tick_count)
@@ -296,6 +332,15 @@ def run_server(cfg, *, events: Optional[str] = None,
                 conn = key.data
                 try:
                     for line in protocol.recv_lines(conn.sock, conn.buf):
+                        if line is None:
+                            # Oversized line refused at the cap; the
+                            # rest of it streams into the void and the
+                            # connection lives on.
+                            registry.counter("serve_oversized_lines").inc()
+                            protocol.send_msg(conn.sock, protocol.error_msg(
+                                "line exceeds MAX_LINE_BYTES="
+                                f"{protocol.MAX_LINE_BYTES}"))
+                            continue
                         msg = protocol.parse_msg(line)
                         resp = _safe_handle(engine, msg, tracer, registry,
                                             handle or _handle)
@@ -311,6 +356,8 @@ def run_server(cfg, *, events: Optional[str] = None,
                 engine.checkpoint(checkpoint_dir)
                 last_ckpt_tick = engine.tick_count
     finally:
+        if proxy is not None:
+            proxy.stop()
         for s, h in restore_sig:
             signal.signal(s, h)
         sel.close()

@@ -98,6 +98,8 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     screened_events = 0
     screened_updates = 0
     quarantines: List[dict] = []
+    net_faults: List[dict] = []
+    netproxy_summaries: List[dict] = []
     for e in events:
         v = e.get("v")
         if isinstance(v, int) and v > EVENT_SCHEMA_VERSION:
@@ -223,6 +225,13 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             serve_pre_drains.append({"tick": e.get("round"), **payload})
         elif kind == "serve_configure":
             serve_configures += 1
+        # Network timeline (fedtpu.serving.netproxy; docs/resilience.md):
+        # one net_fault event per fired wire fault, one netproxy_summary
+        # per proxied gateway at drain.
+        elif kind == "net_fault":
+            net_faults.append(payload)
+        elif kind == "netproxy_summary":
+            netproxy_summaries.append(payload)
 
     out: dict = {
         "events_total": len(events),
@@ -236,6 +245,7 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         "staleness": None,
         "counters": {}, "gauges": {}, "histograms": {},
         "resilience": None,
+        "network": None,
         "serving": None,
         "cohort": None,
         "autoscale": None,
@@ -315,6 +325,29 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         out["counters"] = dict(last_counters.get("counters") or {})
         out["gauges"] = dict(last_counters.get("gauges") or {})
         out["histograms"] = dict(last_counters.get("histograms") or {})
+    # Built AFTER the counters fold so the wire-fault view can sit next
+    # to the server-side counters the faults are supposed to move
+    # (redirects followed, duplicate frames dropped, oversized lines).
+    if net_faults or netproxy_summaries:
+        per_gateway: dict = {}
+        for f in net_faults:
+            g = int(f.get("gateway") or 0)
+            row = per_gateway.setdefault(g, {})
+            k = f.get("fault") or "unknown"
+            row[k] = row.get(k, 0) + 1
+        out["network"] = {
+            "faults": len(net_faults),
+            "per_gateway": {g: dict(sorted(v.items()))
+                            for g, v in sorted(per_gateway.items())},
+            "proxies": [
+                {k: s.get(k) for k in ("gateway", "digest", "connections",
+                                       "frames", "relayed_frames",
+                                       "frame_bytes", "fired")}
+                for s in netproxy_summaries],
+            "redirects": out["counters"].get("gateway_redirects"),
+            "duplicate_drops": out["counters"].get("serve_duplicate_drop"),
+            "oversized_lines": out["counters"].get("serve_oversized_lines"),
+        }
     hist = out["histograms"].get("staleness")
     if hist or stale_means:
         out["staleness"] = {
@@ -447,6 +480,24 @@ def render_text(agg: dict) -> str:
         for hb in hbs:
             lines.append(f"  heartbeat p{hb.get('process')}: "
                          f"{hb.get('status')}")
+    net = agg.get("network")
+    if net:
+        lines.append("network (wire faults):")
+        for g, kinds in sorted((net.get("per_gateway") or {}).items()):
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(kinds.items()))
+            lines.append(f"  gateway {g}: {detail}")
+        for p in net.get("proxies") or []:
+            # connections - 1 = reconnects forced onto this gateway's
+            # clients; frames - relayed_frames = frames the wire ate.
+            lines.append(
+                f"  proxy g{p.get('gateway')} [{p.get('digest')}]: "
+                f"{p.get('connections')} conn(s), "
+                f"{p.get('frames')} frame(s) "
+                f"({p.get('relayed_frames')} relayed, "
+                f"{p.get('frame_bytes')} B)")
+        for key in ("redirects", "duplicate_drops", "oversized_lines"):
+            if net.get(key) is not None:
+                lines.append(f"  {key}: {net[key]:g}")
     srv = agg.get("serving")
     if srv:
         lines.append("serving:")

@@ -228,6 +228,16 @@ QUICK_TESTS = {
     "test_gateway.py::test_owner_of_and_redirect_msg",
     "test_gateway.py::test_client_partition_matches_gateway_owner",
     "test_gateway.py::test_retried_frame_incorporated_exactly_once",
+    # round-12 modules
+    # wire faults (plan materialization, the scenario registry pin, and
+    # the streaming line cap are backend-free, milliseconds; the proxy
+    # end-to-end, the net-sim golden, and the live chaos rows stay
+    # full-tier)
+    "test_netfaults.py::test_plan_spec_forms_are_identical",
+    "test_netfaults.py::test_plan_validation_rejects_bad_entries",
+    "test_netfaults.py::test_scenario_registry_is_single_source_of_truth",
+    "test_netfaults.py::test_line_cap_streams_bounded_and_connection"
+    "_survives",
 }
 
 

@@ -225,6 +225,26 @@ FIXTURES = {
                 return jax.lax.psum(x, "clients")  # fedtpu: noqa[FTP008] fixture
             """,
     },
+    "FTP009": {
+        "positive": """
+            import socket
+            def connect(host, port):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.connect((host, port))
+                return socket.create_connection((host, port))
+            """,
+        "negative": """
+            import socket
+            def connect(host, port):
+                return socket.create_connection((host, port), timeout=5.0)
+            """,
+        "suppressed": """
+            import socket
+            def listener():
+                s = socket.socket()  # fedtpu: noqa[FTP009] fixture
+                return s
+            """,
+    },
     "FTP101": {
         "positive": """
             def f(xs=[]):

@@ -1,0 +1,429 @@
+"""Wire-level network chaos: NetFaultPlan, the fault proxy, the line
+cap, and the exactly-once contract under torn/replayed frames.
+
+Layers under test (docs/resilience.md "Wire faults"):
+
+- ``fedtpu.resilience.netfaults`` — seeded schedule materialization,
+  canonical digest, validation (backend-free, milliseconds);
+- ``fedtpu.serving.protocol`` — the streaming 8 MB line cap that keeps
+  per-connection memory bounded while the connection survives;
+- ``fedtpu.serving.netproxy`` — deterministic byte relay: accounting,
+  decision log, and the ack-boundary fault semantics driven end-to-end
+  through a REAL engine + a real retrying ``GatewayClient``;
+- ``fedtpu.resilience.chaos`` — the scenario registry as the single
+  source of truth for every scenario grouping and the CLI help;
+- ``fedtpu.resilience.net_sim`` — the pinned campaign vs the committed
+  golden (the tier-1 gate for the whole exactly-once chain).
+
+The three live ``mp_net_*`` chaos rows (2-process gang + proxies +
+subprocess loadgen, minutes each) are full-tier only (`slow`).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from fedtpu.config import ServingConfig
+from fedtpu.resilience.netfaults import (DEFAULT_FRAME_HORIZON, NET_KINDS,
+                                         NetFaultPlan)
+from fedtpu.serving import protocol
+from fedtpu.serving.client import GatewayClient
+from fedtpu.serving.netproxy import NetFaultProxy
+from fedtpu.telemetry.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PLAN = {
+    "seed": 3,
+    "faults": [
+        {"kind": "net_partition", "gateway": 0, "frame": 2, "frames": 3},
+        {"kind": "net_torn_frame", "gateway": 1, "frame": 4,
+         "boundary": "post_ack", "cut_bytes": 32},
+        {"kind": "net_reset", "gateway": 0, "frame": 2, "phase": "accept"},
+        {"kind": "net_dup_frame", "gateway": 1, "frame": 9},
+        {"kind": "net_slow_link", "gateway": 0, "probability": 0.5,
+         "window": [10, 17], "chunk_bytes": 256},
+    ],
+}
+
+
+def _small_cfg(**kw):
+    base = dict(cohort=8, buffer_size=2, tick_interval_s=0.0,
+                data_rows=64, model_hidden=(8,), seed=0)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _engine():
+    from fedtpu.serving.engine import ServingEngine
+    return ServingEngine(_small_cfg(), registry=MetricsRegistry())
+
+
+# ------------------------------------------------------------- the plan
+
+def test_plan_spec_forms_are_identical(tmp_path):
+    """Dict, inline JSON, and file path specs materialize to the same
+    schedule and the same digest — the digest is a pure function of the
+    campaign content."""
+    as_dict = NetFaultPlan.load(PLAN, num_gateways=2)
+    as_json = NetFaultPlan.load(json.dumps(PLAN), num_gateways=2)
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(PLAN))
+    as_file = NetFaultPlan.load(str(path), num_gateways=2)
+    assert as_dict.faults == as_json.faults == as_file.faults
+    assert as_dict.digest == as_json.digest == as_file.digest
+    # Every kind survived materialization; schedule order is canonical.
+    assert {f.kind for f in as_dict.faults} == set(NET_KINDS)
+    keys = [(f.gateway, f.frame, f.kind) for f in as_dict.faults]
+    assert keys == sorted(keys)
+
+
+def test_probabilistic_expansion_is_seed_deterministic():
+    a = NetFaultPlan.load(PLAN, num_gateways=2)
+    b = NetFaultPlan.load(PLAN, num_gateways=2)
+    assert a.faults == b.faults and a.digest == b.digest
+    moved = NetFaultPlan.load(dict(PLAN, seed=4), num_gateways=2)
+    assert moved.digest != a.digest
+    slow = [f for f in a.for_gateway(0) if f.kind == "net_slow_link"]
+    assert slow, "p=0.5 over an 8-frame window fired nowhere (seed bug?)"
+    assert all(10 <= f.frame <= 17 for f in slow)
+
+
+def test_plan_validation_rejects_bad_entries(tmp_path):
+    def load_one(entry, n=2):
+        return NetFaultPlan.load({"faults": [entry]}, num_gateways=n)
+
+    for entry in (
+        {"kind": "net_unplug", "frame": 1},              # unknown kind
+        {"kind": "net_partition", "gateway": 2, "frame": 1},  # bad gateway
+        {"kind": "net_partition"},                       # no frame/prob
+        {"kind": "net_partition", "frame": 0},           # 1-based ordinals
+        {"kind": "net_dup_frame", "frame": 1, "frames": 2},  # not windowed
+        {"kind": "net_torn_frame", "frame": 1, "cut_bytes": 0},
+        {"kind": "net_torn_frame", "frame": 1, "boundary": "mid_ack"},
+        {"kind": "net_slow_link", "frame": 1, "chunk_bytes": 0},
+        {"kind": "net_slow_link", "frame": 1, "delay_s": -0.1},
+        {"kind": "net_reset", "frame": 1, "phase": "connect"},
+        {"kind": "net_partition", "probability": 1.5},
+    ):
+        with pytest.raises(ValueError):
+            load_one(entry)
+    not_an_object = tmp_path / "plan.json"
+    not_an_object.write_text("[]")
+    with pytest.raises(ValueError):
+        NetFaultPlan.load(str(not_an_object))
+
+
+def test_at_frame_and_at_accept_clocks_are_separate():
+    """``net_reset``/``accept`` counts CONNECTIONS, everything else
+    counts frames — the two ordinals must never cross-match."""
+    plan = NetFaultPlan.load(PLAN, num_gateways=2)
+    # frame 2 on gateway 0 carries a partition AND an accept-reset; the
+    # frame clock must see only the partition (window covers 2..4).
+    for k in (2, 3, 4):
+        assert plan.at_frame(0, k).kind == "net_partition"
+    assert plan.at_frame(0, 5) is None or plan.at_frame(0, 5).kind != \
+        "net_partition"
+    assert plan.at_accept(0, 2).phase == "accept"
+    assert plan.at_accept(0, 3) is None
+    assert plan.at_accept(1, 2) is None   # wrong gateway
+    assert plan.at_frame(1, 4).boundary == "post_ack"
+    assert plan.at_frame(1, 1) is None
+    assert DEFAULT_FRAME_HORIZON >= 17    # PLAN's window fits the default
+
+
+# ---------------------------------------------------- the registry pins
+
+def test_scenario_registry_is_single_source_of_truth():
+    from fedtpu.resilience import chaos
+    names = [n for n, _, _ in chaos.SCENARIO_REGISTRY]
+    assert len(names) == len(set(names))
+    assert chaos.SCENARIOS == tuple(names)
+    assert chaos.MP_SCENARIOS == tuple(
+        n for n, fams, _ in chaos.SCENARIO_REGISTRY if "mp" in fams)
+    assert chaos.RESHARD_SCENARIOS == tuple(
+        n for n, fams, _ in chaos.SCENARIO_REGISTRY if "reshard" in fams)
+    assert chaos.GATEWAY_SCENARIOS == ("mp_gateway_kill",
+                                       "mp_store_shard_kill")
+    assert chaos.NET_SCENARIOS == ("mp_net_partition", "mp_slow_gateway",
+                                   "mp_torn_frame")
+    assert chaos.AUTOSCALE_SCENARIO in names
+    assert chaos.POISON_SCENARIO in names
+    # Every net row has a pinned plan that loads for a 2-gateway fleet.
+    for name in chaos.NET_SCENARIOS:
+        plan = NetFaultPlan.load(chaos._NET_PLANS[name], num_gateways=2)
+        assert plan.faults
+    help_text = chaos.scenarios_help()
+    for n in names:
+        assert n in help_text, f"{n} missing from --scenarios help"
+
+
+def test_cli_scenarios_help_is_registry_driven():
+    from fedtpu.cli import build_parser
+    from fedtpu.resilience.chaos import scenarios_help
+    parser = build_parser()
+    sub = next(a for a in parser._actions
+               if getattr(a, "choices", None) and "chaos" in a.choices)
+    chaos_p = sub.choices["chaos"]
+    act = next(a for a in chaos_p._actions
+               if "--scenarios" in a.option_strings)
+    assert act.help == scenarios_help()
+
+
+# ------------------------------------------------------- the line cap
+
+def test_line_cap_streams_bounded_and_connection_survives():
+    """An over-cap line trickled in many small TCP segments is refused
+    AT the cap (one ``None``), never buffered whole, and the NEXT frame
+    on the same connection still parses — the per-error-frame contract
+    with bounded memory."""
+    a, b = socket.socketpair()
+    try:
+        chunk = b"x" * 65536
+        target = protocol.MAX_LINE_BYTES + 2 * len(chunk)
+
+        def writer():
+            sent = 0
+            while sent < target:
+                a.sendall(chunk)
+                sent += len(chunk)
+            a.sendall(b"\n")
+            a.sendall(b'{"op":"hello","v":1}\n')
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        buf = protocol.LineBuffer()
+        got, peak = [], 0
+        for _ in range(4096):
+            got.extend(protocol.recv_lines(b, buf))
+            peak = max(peak, len(buf))
+            if len(got) >= 2:
+                break
+        t.join(timeout=10)
+        assert got[0] is None and buf.dropped == 1
+        assert protocol.parse_msg(got[1]) == {"op": "hello", "v": 1}
+        # Bounded: the buffer never held more than the cap plus one
+        # recv's worth of tail, despite an over-cap line in flight.
+        assert peak <= protocol.MAX_LINE_BYTES + 2 * len(chunk)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_plain_bytearray_keeps_legacy_connection_error():
+    a, b = socket.socketpair()
+    try:
+        t = threading.Thread(
+            target=lambda: a.sendall(b"y" * (protocol.MAX_LINE_BYTES + 2)),
+            daemon=True)
+        t.start()
+        buf = bytearray()
+        with pytest.raises(ConnectionError):
+            for _ in range(4096):
+                list(protocol.recv_lines(b, buf))
+        t.join(timeout=10)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_stamped_refuses_to_restamp_a_retry():
+    c = GatewayClient(port=1)
+    frame = c.stamped({"op": "updates", "events": []})
+    assert frame["seq"] == 1 and frame["nonce"] == c.nonce
+    with pytest.raises(ValueError):
+        c.stamped(frame)                  # a retry must resend, not forge
+    with pytest.raises(ValueError):
+        c.stamped({"op": "updates", "nonce": "other"})
+
+
+# ----------------------------------------------- the proxy, end to end
+
+def _mini_server(engine, stop):
+    """A real-protocol accept loop over ``_handle`` — what run_server
+    does minus the selectors/jit machinery (run_server's ``once`` mode
+    would shut down when the proxy's backend connection drops, which is
+    exactly what fault-driven reconnects do)."""
+    from fedtpu.serving.server import _handle
+    lsock = socket.socket()  # fedtpu: noqa[FTP009] settimeout(0.2) two lines down bounds every accept
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    lsock.settimeout(0.2)
+    lock = threading.Lock()               # engine is single-threaded
+
+    def serve_conn(csock):
+        csock.settimeout(0.2)
+        buf = protocol.LineBuffer()
+        try:
+            while not stop.is_set():
+                try:
+                    lines = list(protocol.recv_lines(csock, buf))
+                except socket.timeout:
+                    continue
+                except (ConnectionError, OSError):
+                    return
+                for line in lines:
+                    msg = protocol.parse_msg(line) if line else None
+                    with lock:
+                        resp = (_handle(engine, msg) if msg is not None
+                                else protocol.error_msg("malformed"))
+                    protocol.send_msg(csock, resp)
+        finally:
+            csock.close()
+
+    def accept_loop():
+        while not stop.is_set():
+            try:
+                csock, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=serve_conn, args=(csock,),
+                             daemon=True).start()
+        lsock.close()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    return lsock.getsockname()[1]
+
+
+def _proxied_client(tmp_path, plan, backend_port):
+    base = str(tmp_path / "port")
+    proxy = NetFaultProxy(NetFaultPlan.load(plan, num_gateways=1), 0,
+                          backend_port,
+                          protocol.net_proxy_port_file(base)).start()
+    # The real port file exists too — the client must PREFER the proxy.
+    (tmp_path / "port").write_text(str(backend_port))
+    client = GatewayClient(port_file=base, retries=8, backoff_s=0.01,
+                           timeout=5.0, seed=0)
+    return proxy, client
+
+
+def test_torn_ack_boundary_retry_is_exactly_once(tmp_path):
+    """THE satellite bar: a connection reset between frame send and ack
+    recv (net_torn_frame @ post_ack) is retryable-with-dedup. The retry
+    resends the SAME stamped seq, the session table answers the original
+    verdict, and the engine incorporates exactly once."""
+    stop = threading.Event()
+    eng = _engine()
+    port = _mini_server(eng, stop)
+    plan = {"seed": 0, "faults": [
+        # frame 1 = hello, frame 2 = the updates frame whose ack dies.
+        {"kind": "net_torn_frame", "gateway": 0, "frame": 2,
+         "boundary": "post_ack", "cut_bytes": 32}]}
+    proxy, client = _proxied_client(tmp_path, plan, port)
+    try:
+        events = [[1, 0.1, 0.0], [2, 0.2, 0.0]]
+        counts = client.send_events(events)
+        assert sum(counts.values()) == len(events)   # ORIGINAL verdicts
+        assert client.stats["retried"] >= 1
+        assert client._seq == 1                      # stamped exactly once
+        assert eng.duplicate_drops == len(events)
+        eng.drain()
+        assert eng.incorporated == len(events)       # never twice
+        stats = proxy.finish()
+        assert stats["fired"] == {"net_torn_frame": 1}
+        assert stats["connections"] >= 2             # the forced reconnect
+        rec = proxy.records[0]
+        assert rec["boundary"] == "post_ack" and rec["at_frame"] == 2
+    finally:
+        stop.set()
+        proxy.stop()
+        client.close()
+
+
+def test_dup_frame_is_absorbed_with_original_verdicts(tmp_path):
+    """A replayed frame (net_dup_frame) reaches the server twice; the
+    duplicate is answered from the session cache (counted, swallowed by
+    the wire) and the client-visible counts are the original ones."""
+    stop = threading.Event()
+    eng = _engine()
+    port = _mini_server(eng, stop)
+    plan = {"seed": 0, "faults": [
+        {"kind": "net_dup_frame", "gateway": 0, "frame": 2}]}
+    proxy, client = _proxied_client(tmp_path, plan, port)
+    try:
+        events = [[1, 0.1, 0.0], [2, 0.2, 0.0], [3, 0.3, 0.0]]
+        counts = client.send_events(events)
+        assert sum(counts.values()) == len(events)
+        assert client.stats["retried"] == 0          # client never noticed
+        # The replay happens AFTER the client's ack came back (that is
+        # the point: the client never waits on it), so give the proxy a
+        # moment to finish the duplicate round-trip.
+        deadline = time.monotonic() + 5.0
+        while eng.duplicate_drops < len(events) and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.duplicate_drops == len(events)    # server counted it
+        eng.drain()
+        assert eng.incorporated == len(events)
+    finally:
+        stop.set()
+        proxy.stop()
+        client.close()
+
+
+def test_proxy_accounting_and_decision_log(tmp_path):
+    """Byte/frame accounting against a clean plan (nothing fires), plus
+    the decision-log artifact shape: header, records, summary."""
+    stop = threading.Event()
+    eng = _engine()
+    port = _mini_server(eng, stop)
+    plan = {"seed": 0, "faults": [
+        {"kind": "net_reset", "gateway": 0, "frame": 2, "phase": "accept"}]}
+    proxy, client = _proxied_client(tmp_path, plan, port)
+    try:
+        client.send_events([[1, 0.1, 0.0]])
+        client.close()                    # conn 2 would be RST; avoid it
+        stats = proxy.finish()
+        assert stats["frames"] == stats["relayed_frames"] == 2  # hello+batch
+        assert stats["frame_bytes"] == stats["bytes_in"] > 0
+        assert stats["digest"] == NetFaultPlan.load(
+            plan, num_gateways=1).digest
+        log = open(f"{tmp_path}/port.net" + "log").read().splitlines()
+        head = json.loads(log[0])
+        assert head["gateway"] == 0 and head["digest"] == stats["digest"]
+        tail = json.loads(log[-1])
+        assert tail["summary"]["frames"] == 2
+        assert tail["summary"]["fired"] == {}
+        # finish() is idempotent — a second call must not re-emit.
+        assert proxy.finish() == stats
+    finally:
+        stop.set()
+        proxy.stop()
+
+
+# --------------------------------------------------- the tier-1 golden
+
+def test_net_sim_matches_committed_golden():
+    """The pinned wire campaign replayed through the real engine/session
+    machinery must match tests/goldens/net_sim.jsonl bitwise — the gate
+    over the whole exactly-once chain."""
+    from fedtpu.resilience.net_sim import compare_decisions, simulate
+    sim = simulate()
+    cmp = compare_decisions(
+        sim["lines"],
+        os.path.join(REPO, "tests", "goldens", "net_sim.jsonl"))
+    assert cmp["ok"], cmp["reason"]
+    s = sim["summary"]
+    assert set(s["fired"]) == set(NET_KINDS)   # the campaign covers all
+    assert s["lost_acked"] == 0
+    assert s["duplicate_drops"] > 0
+    assert s["incorporated"] == s["arrivals"]
+
+
+# ------------------------------------------------- the live chaos rows
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ("mp_net_partition", "mp_slow_gateway",
+                                  "mp_torn_frame"))
+def test_net_chaos_row(name, tmp_path):
+    from fedtpu.resilience.chaos import run_scenario
+    row = run_scenario(name, str(tmp_path), {}, 0, 0,
+                       platform="cpu", timeout=570)
+    assert row["ok"], row
