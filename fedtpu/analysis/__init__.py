@@ -11,6 +11,7 @@ Two halves:
         FTP005  bare print() outside the telemetry output layer
         FTP006  jit wrapper rebuilt per loop iteration / per call
         FTP009  socket.socket()/create_connection() without a timeout
+        FTP010  wall-clock pair timing a jitted call without a device sync
         FTP101  mutable default arguments
         FTP102  broad except that swallows all errors
         Suppress per line with ``# fedtpu: noqa[FTP001] <justification>``.

@@ -1,4 +1,4 @@
-"""JAX-aware rules: FTP001-FTP004, FTP006, FTP008.
+"""JAX-aware rules: FTP001-FTP004, FTP006, FTP008, FTP010.
 
 All four rules hang off the same module-level reachability analysis: a
 function is *traced* if it is decorated with (or passed to) a JAX
@@ -900,3 +900,151 @@ def check_unbound_collective_axis(
                 "PartitionSpec literal, no *_AXIS constant); import the "
                 "engine's axis constant instead of retyping the string",
             )
+
+
+# ---------------------------------------------------------------------------
+# FTP010 — wall-clock timing around a jitted call without a device sync
+# ---------------------------------------------------------------------------
+
+
+# Wall-clock reads that a timing pair would use: ``time.time()``,
+# ``time.perf_counter()``, ``time.monotonic()`` (+ the _ns variants), and
+# the same names bare after ``from time import perf_counter``.
+_WALL_CLOCK_FNS = {
+    "time", "perf_counter", "monotonic",
+    "time_ns", "perf_counter_ns", "monotonic_ns",
+}
+
+# Calls that force device work to completion (or materialize a device
+# value on host, which transitively waits on it).  Over-matching here is
+# safe: a spurious "sync" only turns a would-be finding into a false
+# negative, never the reverse.
+_DEVICE_SYNC_ATTRS = {
+    "block_until_ready", "force_fetch", "end_after_fetch", "device_get",
+    "item", "asarray", "array", "tolist",
+}
+_DEVICE_SYNC_NAMES = {
+    "block_until_ready", "force_fetch", "device_get",
+    "float", "int", "asarray",
+}
+
+
+def _clock_read(node: ast.Call) -> bool:
+    chain = _attr_chain(node.func)
+    if len(chain) == 2 and chain[0] == "time" and chain[1] in _WALL_CLOCK_FNS:
+        return True
+    if len(chain) == 1 and chain[0] in _WALL_CLOCK_FNS:
+        return True
+    return False
+
+
+def _device_sync(node: ast.Call) -> bool:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr in _DEVICE_SYNC_ATTRS
+    if isinstance(node.func, ast.Name):
+        return node.func.id in _DEVICE_SYNC_NAMES
+    return False
+
+
+def _callable_label(node: ast.Call) -> str:
+    chain = _attr_chain(node.func)
+    return ".".join(chain) if chain else "<call>"
+
+
+def _jitted_names(tree: ast.AST, index: _ModuleIndex) -> set[str]:
+    """Names whose call sites dispatch async device work in this module:
+    traced functions, donated callables, and anything bound from a
+    ``jax.jit(...)`` construction."""
+    names = {n for n, i in index.functions.items() if i.traced}
+    names |= set(index.donated_callables)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and _is_jit_construction(node.value)
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _timing_events(
+    body: list[ast.stmt], jit_names: set[str]
+) -> list[tuple[int, int, str, ast.Call]]:
+    """Source-ordered (line, col, kind, node) events in one scope.
+
+    Nested function/lambda bodies are skipped — they are their own
+    scopes and their clock reads execute at *their* call time, not
+    lexically between the enclosing scope's reads.
+    """
+    events: list[tuple[int, int, str, ast.Call]] = []
+
+    def walk(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            if _clock_read(node):
+                events.append((node.lineno, node.col_offset, "clock", node))
+            elif _device_sync(node):
+                events.append((node.lineno, node.col_offset, "sync", node))
+            elif (
+                isinstance(node.func, ast.Name) and node.func.id in jit_names
+            ) or _is_jit_construction(node.func):
+                events.append((node.lineno, node.col_offset, "jit", node))
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for stmt in body:
+        walk(stmt)
+    events.sort(key=lambda e: (e[0], e[1]))
+    return events
+
+
+@rule(
+    "FTP010",
+    "unsynced-wall-clock-timing",
+    "A pair of wall-clock reads (time.time()/perf_counter()/monotonic()) "
+    "bracketing a jitted-callable invocation with no device sync "
+    "(block_until_ready/force_fetch/.item()/np.asarray) between them — "
+    "JAX dispatch is asynchronous, so the delta measures enqueue time, "
+    "not device compute.",
+)
+def check_unsynced_timing(tree: ast.AST, src: str, path: str) -> Iterable[Finding]:
+    index = _ModuleIndex(tree)
+    jit_names = _jitted_names(tree, index)
+
+    scopes: list[tuple[str, list[ast.stmt]]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append((node.name, node.body))
+    if isinstance(tree, ast.Module):
+        scopes.append(("<module>", tree.body))
+
+    for scope_name, body in scopes:
+        events = _timing_events(body, jit_names)
+        for i, (_, _, kind, _node) in enumerate(events):
+            if kind != "clock":
+                continue
+            # Pair with the *next* clock read only: t0 ... work ... t1.
+            for j in range(i + 1, len(events)):
+                if events[j][2] != "clock":
+                    continue
+                between = events[i + 1 : j]
+                jit_evs = [e for e in between if e[2] == "jit"]
+                if jit_evs and not any(e[2] == "sync" for e in between):
+                    t1 = events[j][3]
+                    yield Finding(
+                        rule="FTP010",
+                        path=path,
+                        line=t1.lineno,
+                        col=t1.col_offset,
+                        message=f"[in `{scope_name}`] wall-clock pair "
+                        f"brackets jitted call "
+                        f"`{_callable_label(jit_evs[0][3])}` (line "
+                        f"{jit_evs[0][0]}) with no block_until_ready/"
+                        "force_fetch/host materialization in between — "
+                        "async dispatch means the delta times the "
+                        "enqueue, not the compute",
+                    )
+                break

@@ -187,6 +187,11 @@ def _add_common_overrides(p: argparse.ArgumentParser):
                         "Also honored via JAX_COMPILATION_CACHE_DIR.")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of the round loop here")
+    p.add_argument("--profile-rounds", type=int, default=None, metavar="K",
+                   help="with --profile-dir: capture only a K-round "
+                        "steady-state window (starts after the first "
+                        "chunk, so compile time is excluded); 0 traces "
+                        "the whole run")
     p.add_argument("--metrics-jsonl", default=None,
                    help="append one JSON line of metrics per round")
     p.add_argument("--events", default=None, metavar="JSONL",
@@ -356,6 +361,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["overlap_compile"] = True
     if args.profile_dir is not None:
         run_kw["profile_dir"] = args.profile_dir
+    if getattr(args, "profile_rounds", None) is not None:
+        run_kw["profile_rounds"] = args.profile_rounds
     if args.metrics_jsonl is not None:
         run_kw["metrics_jsonl"] = args.metrics_jsonl
     if args.log_per_client:
@@ -739,6 +746,31 @@ def build_parser() -> argparse.ArgumentParser:
                           help="gang size for --heartbeat (per-process "
                                "files <base>.p<i>; default 1)")
 
+    # Causal fleet timeline: merges events sinks, netproxy logs and
+    # autoscale decision logs into one ordered view. Like report, pure
+    # reader — stdlib only, no backend, no preset.
+    timeline_p = sub.add_parser(
+        "timeline",
+        help="merge events JSONL sinks + netproxy *.netlog + autoscale "
+             "decision logs into one causal fleet timeline "
+             "(deterministic JSONL or Chrome/Perfetto trace JSON)")
+    timeline_p.add_argument(
+        "artifacts", nargs="+",
+        help="events JSONL path(s), *.netlog proxy logs, and/or "
+             "autoscale decision JSONL — classified automatically")
+    timeline_p.add_argument(
+        "--format", choices=["jsonl", "chrome"], default="jsonl",
+        help="'jsonl' = deterministic canonical lines (wall-clock-free, "
+             "goldenable); 'chrome' = trace-event JSON for Perfetto / "
+             "chrome://tracing (default jsonl)")
+    timeline_p.add_argument(
+        "--output", default=None, metavar="PATH",
+        help="write the rendering here instead of stdout")
+    timeline_p.add_argument(
+        "--expand", action="store_true",
+        help="also pick up sibling fleet artifacts derived from each "
+             "events path (*.g<i>, *.p<i>, *.netlog)")
+
     # Static analysis: pure AST, no backend, no preset — safe in any
     # environment (CI lint gates, pre-commit).
     lint_p = sub.add_parser("lint",
@@ -809,6 +841,14 @@ def build_parser() -> argparse.ArgumentParser:
                               "engine/session machinery) and compare "
                               "its decision log bitwise against this "
                               "golden JSONL, folded into the exit code")
+    check_p.add_argument("--timeline-sim", default=None, metavar="GOLDEN",
+                         help="also replay the pinned two-gateway causal "
+                              "trace campaign (stamped frames + a "
+                              "deliberate retry through the real "
+                              "engine/session machinery) and compare the "
+                              "merged deterministic timeline bitwise "
+                              "against this golden JSONL, folded into "
+                              "the exit code")
     check_p.add_argument("--gateway-probe", default=None,
                          metavar="PORT_FILE_BASE",
                          help="also probe a live gateway fleet's health "
@@ -1228,6 +1268,25 @@ def main(argv=None) -> int:
                 f.write(prom)
         return 0
 
+    if args.cmd == "timeline":
+        # Pure reader like report: no preset, no backend.
+        from fedtpu.telemetry.timeline import (default_artifacts,
+                                               render_timeline)
+        paths = []
+        for p in args.artifacts:
+            expanded = (default_artifacts(p) if args.expand
+                        and not p.endswith(".netlog") else [p])
+            for q in expanded:
+                if q not in paths:
+                    paths.append(q)
+        rendered = render_timeline(paths, fmt=args.format)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(rendered + "\n")
+        else:
+            print(rendered)
+        return 0
+
     if args.cmd == "supervise":
         # Before the platform pin: the supervisor parent never imports
         # jax — it only forks children, so it survives backend crashes.
@@ -1541,6 +1600,25 @@ def main(argv=None) -> int:
                 "duplicate_drops": sim["summary"]["duplicate_drops"],
                 "lost_acked": sim["summary"]["lost_acked"]}
             report["ok"] = report["ok"] and cmp["ok"]
+        if args.timeline_sim:
+            # Fold the pinned causal-trace campaign into the check: the
+            # merged two-gateway timeline (trace chains, dedup legs,
+            # stage ordering) must match the committed golden bitwise —
+            # drift anywhere in the trace-id derivation, the stage
+            # emission points, or the canonicalization fails the gate.
+            from fedtpu.telemetry.timeline_sim import (compare_decisions as
+                                                       _cmp_tl)
+            from fedtpu.telemetry.timeline_sim import simulate as _sim_tl
+            sim = _sim_tl()
+            cmp = _cmp_tl(sim["lines"], args.timeline_sim)
+            report["timeline_sim"] = {
+                "ok": cmp["ok"], "reason": cmp["reason"],
+                "golden": args.timeline_sim,
+                "chains": sim["summary"]["chains"],
+                "retry_duplicate": sim["summary"]["retry_duplicate"],
+                "retry_stages": sim["summary"]["retry_stages"],
+                "incorporated": sim["summary"]["incorporated"]}
+            report["ok"] = report["ok"] and cmp["ok"]
         if args.gateway_probe:
             # Fold a live fleet health probe into the check: every member
             # must answer a stats round-trip on its derived port file.
@@ -1577,6 +1655,11 @@ def main(argv=None) -> int:
                       f"incorporated={n['incorporated']} "
                       f"dups={n['duplicate_drops']} "
                       f"lost_acked={n['lost_acked']}")
+            if "timeline_sim" in report:
+                t = report["timeline_sim"]
+                print(f"timeline-sim: ok={t['ok']} ({t['reason']}) "
+                      f"chains={t['chains']} "
+                      f"retry_duplicate={t['retry_duplicate']}")
             if "gateway_probe" in report:
                 for r in report["gateway_probe"]:
                     state = ("up" if r["ok"]

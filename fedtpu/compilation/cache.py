@@ -324,8 +324,12 @@ class ProgramCache:
         compile_s = time.perf_counter() - t0
         meta: Dict[str, Any] = {"label": label, "compile_s": compile_s}
         try:
-            from fedtpu.utils.timing import program_flops
+            from fedtpu.utils.timing import (program_bytes_accessed,
+                                             program_flops)
             meta["flops"] = program_flops(compiled)
+            # Memory side of the roofline: with flops this gives the
+            # program's arithmetic intensity without re-lowering.
+            meta["bytes_accessed"] = program_bytes_accessed(compiled)
         except Exception:  # fedtpu: noqa[FTP102] flops are advisory metadata; cost_analysis availability varies by backend
             pass
         if extra_meta:

@@ -320,6 +320,10 @@ class RunConfig:
     keep_checkpoints: int = 0
     eval_test_every: int = 0             # 0 = disabled; reference never uses its test split (FL_CustomMLP...:243-246)
     profile_dir: Optional[str] = None    # jax.profiler trace of the round loop
+    # With profile_dir set: 0 traces the whole run; K > 0 captures a
+    # steady-state window — start after the first chunk (compile excluded),
+    # stop at the first chunk boundary covering >= K rounds.
+    profile_rounds: int = 0
     metrics_jsonl: Optional[str] = None  # append one JSON line per round
     mesh_devices: int = 0                # 0 = all visible devices
     # Failure detection (SURVEY.md §5: the reference's only failure handling

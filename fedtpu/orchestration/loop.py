@@ -546,8 +546,9 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     #     host-addressable; `_rep` re-lays a pytree out replicated (GSPMD
     #     inserts the cross-host all-gathers), which also keeps the
     #     early-stop/divergence control flow consensual on every process;
-    #   * print/JSONL/telemetry side effects happen on process 0 only —
-    #     non-zero processes get a NullTracer and a silent logger — but
+    #   * print/console side effects happen on process 0 only — every
+    #     process gets a real role-scoped tracer (peers write to the
+    #     derived ``<events>.p<i>`` sink) but a silent logger — but
     #     NOT checkpoint writes: orbax save is a collective (every process
     #     must call it or the job deadlocks in orbax's internal barrier),
     #     with each process persisting the client shards it owns to the
@@ -601,7 +602,16 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     verbose = verbose and io_proc
 
     tel = cfg.run.telemetry
-    tracer = make_tracer(tel.events_path if io_proc else None)
+    # Schema-v2 identity: every process gets a REAL role-scoped tracer.
+    # Process 0 keeps the configured sink; peers derive ``<events>.p<i>``
+    # (the heartbeat derivation rule) so each file stays single-writer
+    # and `fedtpu timeline` / merged `fedtpu report` can key per-process
+    # sections explicitly instead of colliding on run_id.
+    events_path = tel.events_path
+    if events_path and not io_proc:
+        events_path = f"{events_path}.p{jax.process_index()}"
+    tracer = make_tracer(events_path, role="run",
+                         process_index=jax.process_index())
     registry = default_registry()
     registry.reset()
     install_compile_probe()
@@ -813,6 +823,29 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # The audit is diagnostic metadata; a trace failure must not
             # take down the run it describes.
             manifest_extra["audit"] = {"error": str(exc)}
+        try:
+            # Device-time attribution (docs/observability.md): XLA's own
+            # cost model for the canonical width-1 round. `fedtpu report`
+            # joins these static counts with the measured chunk span
+            # durations into per-round MFU / roofline rows. Trace+lower
+            # only — no compile — so the manifest stays cheap and
+            # deterministic.
+            costs = exp.make_step(1).lower(state, batch).cost_analysis()
+            if isinstance(costs, (list, tuple)):  # pre-0.5 jax: [dict]
+                costs = costs[0] if costs else {}
+            profile: dict = {
+                "flops_per_round": float(costs.get("flops", 0.0)),
+                "bytes_per_round": float(costs.get("bytes accessed", 0.0)),
+                "profile_rounds": int(cfg.run.profile_rounds),
+            }
+            peak_env = os.environ.get("FEDTPU_PEAK_FLOPS")
+            if peak_env:
+                # Hardware peak for MFU denominators; benchmarks pin the
+                # measured v5e figure in benchmarks/RESULTS.md.
+                profile["peak_flops"] = float(peak_env)
+            manifest_extra["profile"] = profile
+        except Exception as exc:
+            manifest_extra["profile"] = {"error": str(exc)}
         tracer.event("manifest", **build_manifest(
             cfg=cfg, mesh=exp.mesh, extra=manifest_extra))
     # Estimated exchange volume per round: every client ships one model's
@@ -1275,10 +1308,20 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
 
     jsonl = (open(cfg.run.metrics_jsonl, "a")
              if cfg.run.metrics_jsonl and io_proc else None)
-    if cfg.run.profile_dir:
+    # Windowed device profiling (--profile-rounds K, K > 0): the
+    # jax.profiler capture is deferred until the FIRST chunk's metrics
+    # land on host — compile and warmup never pollute the window — and
+    # stops once K steady-state rounds are covered (chunk granularity:
+    # the window closes at the first chunk boundary at or past K).
+    # K == 0 keeps the historical whole-run trace.
+    prof_win = {"on": False, "start_round": 0,
+                "pending": bool(cfg.run.profile_dir
+                                and cfg.run.profile_rounds > 0)}
+    if cfg.run.profile_dir and cfg.run.profile_rounds <= 0:
         # Tracing subsystem the reference lacks entirely (SURVEY.md §5):
         # capture a device profile of the round loop for xprof/tensorboard.
         jax.profiler.start_trace(cfg.run.profile_dir)
+        prof_win["on"] = True
 
     # try/finally so a mid-run failure (OOM, Ctrl-C, I/O error) still
     # finalizes the profiler trace and closes the jsonl handle — the trace
@@ -1320,6 +1363,27 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # the proof the chunk's device work finished).
             tracer.event("span", phase="chunk", round=rnd0 + take,
                          dur_s=dt * take, rounds=take)
+            # Windowed profiler control: arm after the first chunk's fetch
+            # (the completion proof that compile is behind us), disarm at
+            # the first chunk boundary covering >= profile_rounds rounds —
+            # the fetch above already proved the window's device work
+            # finished, so stop_trace here loses nothing.
+            if prof_win["pending"]:
+                prof_win["pending"] = False
+                prof_win["on"] = True
+                prof_win["start_round"] = rnd0 + take
+                jax.profiler.start_trace(cfg.run.profile_dir)
+                tracer.event("profile_window", phase="start",
+                             round=rnd0 + take,
+                             rounds=int(cfg.run.profile_rounds))
+            elif (prof_win["on"] and cfg.run.profile_rounds > 0
+                    and rnd0 + take - prof_win["start_round"]
+                    >= cfg.run.profile_rounds):
+                jax.profiler.stop_trace()
+                prof_win["on"] = False
+                tracer.event("profile_window", phase="stop",
+                             round=rnd0 + take,
+                             rounds=rnd0 + take - prof_win["start_round"])
             # Host-side decision window (history/log/early-stop); ended at
             # every exit of the loop below — Span.end is idempotent.
             sp_stop = tracer.span("stop_check", round=rnd0 + take)
@@ -1991,7 +2055,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             # Don't wait on a background compile the run never needed
             # (early stop before the first wide chunk).
             overlap_exec.shutdown()
-        if cfg.run.profile_dir:
+        if prof_win["on"]:
             # Completion proof before finalizing the trace —
             # block_until_ready does not synchronize on the axon transport,
             # and a trace stopped early would miss the device activity it

@@ -208,7 +208,7 @@ def supervise(child_argv: Sequence[str], max_restarts: int = 2,
     ``python -m fedtpu.cli`` child command.
     """
     from fedtpu.telemetry import make_tracer
-    tracer = make_tracer(events)
+    tracer = make_tracer(events, role="supervisor")
     prefix = (list(_cmd_prefix) if _cmd_prefix is not None
               else [sys.executable, "-m", "fedtpu.cli"])
     base = list(child_argv)
@@ -251,6 +251,7 @@ def supervise(child_argv: Sequence[str], max_restarts: int = 2,
             if signaled["sig"] is not None:
                 tracer.event("supervisor_exit", rc=rc, reason="signaled",
                              restarts=restarts)
+                tracer.flush_crash(reason=f"signaled:rc={rc}")
                 return rc
             if rc in (EXIT_OK, EXIT_DIVERGED):
                 # 3 is a POLICY halt: restarting would deterministically
@@ -258,12 +259,17 @@ def supervise(child_argv: Sequence[str], max_restarts: int = 2,
                 tracer.event("supervisor_exit", rc=rc,
                              reason="done" if rc == EXIT_OK else "diverged",
                              restarts=restarts)
+                # Flight-recorder flush on the 0/3 exit paths: the ring
+                # of supervisor events (child_start/exit/restarts) is the
+                # post-mortem timeline a chaos-row failure ships.
+                tracer.flush_crash(reason=f"exit:rc={rc}")
                 if rc == EXIT_OK:
                     _cleanup_run_artifacts(base, heartbeat)
                 return rc
             if restarts >= max_restarts:
                 tracer.event("supervisor_exit", rc=rc,
                              reason="budget_exhausted", restarts=restarts)
+                tracer.flush_crash(reason=f"budget_exhausted:rc={rc}")
                 if verbose:
                     print(f"[supervise] rc={rc} with restart budget "
                           f"exhausted ({max_restarts}); giving up")
@@ -430,7 +436,7 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
                          heartbeat=heartbeat, events=events,
                          extra_env=extra_env, healthy_window=healthy_window,
                          _cmd_prefix=_cmd_prefix, verbose=verbose)
-    tracer = make_tracer(events)
+    tracer = make_tracer(events, role="supervisor")
     prefix = (list(_cmd_prefix) if _cmd_prefix is not None
               else [sys.executable, "-m", "fedtpu.cli"])
     base = list(child_argv)
@@ -486,11 +492,13 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
             if signaled["sig"] is not None:
                 tracer.event("supervisor_exit", rc=rc, reason="signaled",
                              restarts=restarts)
+                tracer.flush_crash(reason=f"signaled:rc={rc}")
                 return rc
             if rc in (EXIT_OK, EXIT_DIVERGED):
                 tracer.event("supervisor_exit", rc=rc,
                              reason="done" if rc == EXIT_OK else "diverged",
                              restarts=restarts)
+                tracer.flush_crash(reason=f"exit:rc={rc}")
                 if rc == EXIT_OK:
                     _cleanup_run_artifacts(base, heartbeat,
                                            num_processes=num_processes)
@@ -498,6 +506,7 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
             if restarts >= max_restarts:
                 tracer.event("supervisor_exit", rc=rc,
                              reason="budget_exhausted", restarts=restarts)
+                tracer.flush_crash(reason=f"budget_exhausted:rc={rc}")
                 if verbose:
                     print(f"[supervise] gang rc={rc} (proc {proc}) with "
                           f"restart budget exhausted ({max_restarts}); "

@@ -112,11 +112,18 @@ class GatewayClient:
         the resend carries the SAME seq, so the server's session table
         answers the original verdict instead of incorporating twice.
         Re-stamping an already-stamped frame would forge a "new" frame
-        out of a retry and break exactly-once, so it is refused here."""
+        out of a retry and break exactly-once, so it is refused here.
+
+        The stamp also carries the causal ``trace`` id — a pure digest
+        of (nonce, seq), so the retry that resends this frame resends
+        the same trace id and the fleet timeline shows ONE logical
+        update across the retry (protocol.trace_id)."""
         if "seq" in obj or "nonce" in obj:
             raise ValueError("frame already carries an idempotency stamp; "
                              "retries must resend it, never re-stamp")
-        return dict(obj, nonce=self.nonce, seq=self.next_seq())
+        seq = self.next_seq()
+        return dict(obj, nonce=self.nonce, seq=seq,
+                    trace=protocol.trace_id(self.nonce, seq))
 
     # -- connections ---------------------------------------------------
     def _path_for(self, gateway: int) -> Optional[str]:
@@ -184,8 +191,12 @@ class GatewayClient:
         time.sleep(cap * (0.5 + self._rng.random()))  # jitter: [0.5, 1.5)x
 
     def hello(self, gateway: int = 0) -> dict:
-        """Connect (with the retry ladder) and return the welcome."""
-        self.request({"op": "hello", "v": protocol.PROTOCOL_VERSION},
+        """Connect (with the retry ladder) and return the welcome. The
+        hello rides the session trace id at seq 0, so a fleet timeline
+        can attribute even pre-update handshakes to this session."""
+        self.request({"op": "hello", "v": protocol.PROTOCOL_VERSION,
+                      "nonce": self.nonce,
+                      "trace": protocol.trace_id(self.nonce, 0)},
                      gateway=gateway)
         return self._welcome.get(gateway, {})
 

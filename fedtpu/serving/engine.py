@@ -110,6 +110,12 @@ class _Pending:
     user: int
     elig_tick: int      # first tick index this entry may ride
     poison: float = 0.0  # adversarial weight scale (traces v2); 0 = honest
+    # Causal trace id of the frame that carried this update
+    # (protocol.trace_id). Telemetry-only: NOT persisted by checkpoint()
+    # — pendings restored across a kill lose trace attribution, but the
+    # WAL replay re-offers them with their original trace so the live
+    # resume path keeps the chain intact.
+    trace_id: Optional[str] = None
 
 
 class SlotBinder:
@@ -360,8 +366,18 @@ class ServingEngine:
             del self._applies_t[:-_APPLIES_KEEP]
             del self._applies_v[:-_APPLIES_KEEP]
 
+    def _trace(self, stage: str, trace, **fields) -> None:
+        """Emit one causal-trace event (kind 'trace', phase = stage) for
+        the logical frame ``trace`` (protocol.trace_id). No-op without a
+        trace id so untraced paths (tests driving offer() directly, old
+        clients) pay one truthiness check."""
+        if trace:
+            self.tracer.event("trace", phase=stage, round=self.tick_count,
+                              trace_id=str(trace), **fields)
+
     def offer(self, t: float, user: int, lat: float,
-              version: Optional[int] = None, poison: float = 0.0) -> str:
+              version: Optional[int] = None, poison: float = 0.0,
+              trace: Optional[str] = None) -> str:
         """Admit (or not) one arriving update; fires any due ticks first.
 
         Returns the admission verdict. Admitted updates queue per USER
@@ -369,7 +385,9 @@ class ServingEngine:
         become eligible at the NEXT tick (one tick later when
         deprioritized). ``poison`` is the trace-carried adversarial
         weight scale (0 for honest updates) — the fault-injection hook
-        the defense screen is measured against.
+        the defense screen is measured against. ``trace`` is the causal
+        trace id of the carrying frame: the admission verdict and the
+        K-buffer insert are emitted against it, in virtual time.
         """
         self.clock.advance(t)
         self._fire_due()
@@ -377,42 +395,53 @@ class ServingEngine:
             # Quarantined senders are refused at the door — no token
             # spent, no queue entry, counted under admission_screened.
             self.registry.counter("serve_quarantine_refusals").inc()
-            return self.admission.record(SCREENED, self.clock.now)
+            verdict = self.admission.record(SCREENED, self.clock.now)
+            self._trace("admit", trace, user=int(user), verdict=verdict,
+                        t_virtual=float(t))
+            return verdict
         pulled = (int(version) if version is not None
                   else self.pulled_version(t - lat))
         staleness = max(0, self.version - pulled)
         verdict = self.admission.decide(self.clock.now, staleness,
                                         len(self.pending))
+        self._trace("admit", trace, user=int(user), verdict=verdict,
+                    t_virtual=float(t))
         if verdict in ADMITTED:
             elig = self.tick_count + (2 if verdict == DEPRIORITIZE else 1)
             self.pending.append(_Pending(t=float(t), user=int(user),
                                          elig_tick=elig,
-                                         poison=float(poison)))
+                                         poison=float(poison),
+                                         trace_id=(str(trace) if trace
+                                                   else None)))
+            self._trace("buffer_insert", trace, user=int(user),
+                        elig_tick=elig, t_virtual=float(t))
             self.registry.gauge("serve_pending").set(len(self.pending))
             if self.flush_every and self._eligible_count() >= self.flush_every:
                 self._tick(self.clock.now)
         return verdict
 
-    def offer_many(self, events) -> dict:
+    def offer_many(self, events, trace: Optional[str] = None) -> dict:
         """Batch ingestion: ``events`` is an iterable of
         ``(user, t, lat)`` rows, optionally extended with
         ``version`` and ``poison`` columns (the protocol's ``updates``
-        frame / trace replay). Returns per-verdict counts for the
-        batch."""
+        frame / trace replay). ``trace`` is the carrying frame's causal
+        id — every row of a batch shares it (frame-scoped tracing).
+        Returns per-verdict counts for the batch."""
         counts: dict = {}
         for row in events:
             version = (int(row[3]) if len(row) > 3 and row[3] is not None
                        else None)
             poison = float(row[4]) if len(row) > 4 else 0.0
             v = self.offer(float(row[1]), int(row[0]), float(row[2]),
-                           version=version, poison=poison)
+                           version=version, poison=poison, trace=trace)
             counts[v] = counts.get(v, 0) + 1
         return counts
 
     # ------------------------------------------------------------------
     # idempotent sessions + write-ahead log
 
-    def session_check(self, nonce, seq, n_events: int) -> Optional[dict]:
+    def session_check(self, nonce, seq, n_events: int,
+                      trace: Optional[str] = None) -> Optional[dict]:
         """Idempotency gate for a session-stamped frame. None means new
         work — process it, then :meth:`session_commit`. A frame at or
         below the session's high-water seq is a client retry after a
@@ -430,7 +459,10 @@ class ServingEngine:
         self.duplicate_drops += n
         self.registry.counter("serve_duplicate_drop").inc(n)
         self.tracer.event("serve_duplicate_drop", round=self.tick_count,
-                          nonce=str(nonce), seq=int(seq), events=n)
+                          nonce=str(nonce), seq=int(seq), events=n,
+                          **({"trace_id": str(trace)} if trace else {}))
+        self._trace("dedup_drop", trace, nonce=str(nonce), seq=int(seq),
+                    events=n)
         return dict(last[1]) if int(seq) == last[0] else {"duplicate": n}
 
     def session_commit(self, nonce, seq, counts: dict) -> None:
@@ -438,12 +470,16 @@ class ServingEngine:
             return
         self._sessions[str(nonce)] = [int(seq), dict(counts)]
 
-    def wal_append(self, nonce, seq, rows) -> None:
+    def wal_append(self, nonce, seq, rows,
+                   trace: Optional[str] = None) -> None:
         """Durability write for one admitted frame: rows are
         ``[user, t, lat]`` (optionally ``+ [version, poison]``). Appended +
         flushed BEFORE the frame is processed, so every acked update is
         either in a checkpoint or in the WAL; checkpoint() truncates it
-        once state is durable. No-op until ``wal_path`` is set."""
+        once state is durable. No-op until ``wal_path`` is set. The
+        frame's causal ``trace`` id is persisted with the entry (so a
+        WAL replay re-offers under the original id) and emitted as the
+        'wal' trace stage."""
         if not self.wal_path:
             return
         import json
@@ -452,6 +488,10 @@ class ServingEngine:
         entry = {"nonce": None if nonce is None else str(nonce),
                  "seq": None if seq is None else int(seq),
                  "events": [list(r) for r in rows]}
+        if trace:
+            entry["trace"] = str(trace)
+        self._trace("wal", trace, nonce=entry["nonce"], seq=entry["seq"],
+                    events=len(entry["events"]))
         with open(self.wal_path, "a", encoding="utf-8") as fh:
             fh.write(json.dumps(entry, sort_keys=True,
                                 separators=(",", ":")) + "\n")
@@ -482,8 +522,9 @@ class ServingEngine:
                 except ValueError:
                     break  # torn tail write: nothing after it is valid
                 rows = entry.get("events") or []
-                if self.session_check(entry.get("nonce"),
-                                      entry.get("seq"), len(rows)) is not None:
+                if self.session_check(entry.get("nonce"), entry.get("seq"),
+                                      len(rows),
+                                      trace=entry.get("trace")) is not None:
                     continue
                 counts: dict = {}
                 for r in rows:
@@ -492,7 +533,8 @@ class ServingEngine:
                                             and r[3] is not None
                                             else None),
                                    poison=(float(r[4]) if len(r) > 4
-                                           else 0.0))
+                                           else 0.0),
+                                   trace=entry.get("trace"))
                     counts[v] = counts.get(v, 0) + 1
                     replayed += 1
                 self.session_commit(entry.get("nonce"), entry.get("seq"),
@@ -670,6 +712,12 @@ class ServingEngine:
         self.incorporated += len(incorporated)
         self.registry.counter("serve_updates_incorporated").inc(
             len(incorporated))
+        # Close each traced update's causal chain at its incorporation
+        # tick — emitted in virtual time, after tick_count advanced to
+        # this tick, so the chain replays bitwise.
+        for p in incorporated:
+            self._trace("incorporate", p.trace_id, user=int(p.user),
+                        t_virtual=float(t_fire))
         self._record_tick(t_fire, len(incorporated), len(slots))
         return len(incorporated)
 

@@ -300,7 +300,7 @@ def run_gateway(cfg, *, gateway_index: Optional[int] = None,
         start_extra={"gateway": i, "num_gateways": n,
                      "generation": generation},
         net_fault_plan=net_fault_plan, net_gateway_index=i,
-        net_num_gateways=n)
+        net_num_gateways=n, role=f"gateway-{i}")
 
 
 def probe_fleet(port_file: str, num_gateways: int,

@@ -47,6 +47,14 @@ gateway owns is refused with an error frame carrying a ``"redirect"``
 object naming the owner — ``{"gateway": g, "num_gateways": N,
 "port_file": ...}`` — which the retrying client follows.
 
+Causal tracing (v1, optional field): a stamped frame may carry
+``"trace"`` — the deterministic ``trace_id(nonce, seq)`` digest. The
+trace id is a PURE function of the idempotency stamp (never wall time),
+so a retried frame carries the SAME id and the merged fleet timeline
+(`fedtpu timeline`) shows client-stamp -> gateway-WAL -> dedup-drop ->
+incorporation as one logical update. Servers derive the id themselves
+when the field is absent, so old clients still get traced.
+
 Anything unparseable or unknown gets ``{"op": "error", ...}`` and the
 connection stays up — a load generator mid-replay should not lose its
 socket to one malformed frame.
@@ -57,6 +65,7 @@ stdlib only.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import socket
 from typing import Iterator, Optional
@@ -159,6 +168,16 @@ def parse_msg(line: bytes) -> Optional[dict]:
 
 def error_msg(reason: str) -> dict:
     return {"op": "error", "v": PROTOCOL_VERSION, "reason": reason}
+
+
+def trace_id(nonce, seq) -> str:
+    """Deterministic causal-trace id of one logical frame: a pure digest
+    of the idempotency stamp (nonce, seq) — NEVER wall time — so a retry
+    resending the same stamp carries the same id, and two same-seed
+    passes of a pinned campaign produce bitwise-identical timelines.
+    16 hex chars: collision-safe for a fleet's worth of frames while
+    keeping event lines small."""
+    return hashlib.sha256(f"{nonce}:{int(seq)}".encode()).hexdigest()[:16]
 
 
 def gateway_port_file(base: str, index: int) -> str:

@@ -61,6 +61,23 @@ class _Conn:
         self.buf = protocol.LineBuffer()
 
 
+def _frame_trace(msg: dict):
+    """The frame's causal trace id: the client-stamped ``trace`` field
+    when present, else derived server-side from the idempotency stamp
+    (same pure function — protocol.trace_id — so old clients' frames
+    still chain, and a retry still maps to the SAME id)."""
+    trace = msg.get("trace")
+    if trace:
+        return str(trace)
+    nonce, seq = msg.get("nonce"), msg.get("seq")
+    if nonce is not None and seq is not None:
+        try:
+            return protocol.trace_id(nonce, seq)
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
 def _handle(engine: ServingEngine, msg: dict) -> dict:
     """One request -> one response. Unknown/malformed ops answer with an
     ``error`` frame instead of dropping the connection — a loadgen
@@ -72,11 +89,22 @@ def _handle(engine: ServingEngine, msg: dict) -> dict:
             return protocol.error_msg(
                 f"protocol v={v} unsupported (server speaks "
                 f"v={protocol.PROTOCOL_VERSION})")
+        trace = msg.get("trace")
+        if trace:
+            engine._trace("client_stamp", trace, op="hello",
+                          nonce=(str(msg["nonce"]) if msg.get("nonce")
+                                 else None), seq=0)
         return {"op": "welcome", "v": protocol.PROTOCOL_VERSION,
                 "cohort": engine.C, "version": engine.version}
     if op == "update":
         nonce, seq = msg.get("nonce"), msg.get("seq")
-        cached = engine.session_check(nonce, seq, 1)
+        trace = _frame_trace(msg)
+        # Ingress record FIRST: even a frame the dedup gate drops shows
+        # its arrival in the causal chain.
+        engine._trace("client_stamp", trace, op=op,
+                      nonce=(None if nonce is None else str(nonce)),
+                      seq=(None if seq is None else int(seq)), events=1)
+        cached = engine.session_check(nonce, seq, 1, trace=trace)
         if cached is not None:
             verdict = ("duplicate" if "duplicate" in cached
                        else next(iter(cached)))
@@ -95,10 +123,11 @@ def _handle(engine: ServingEngine, msg: dict) -> dict:
                 row.append(float(msg["poison"]))
         except (KeyError, TypeError, ValueError) as e:
             return protocol.error_msg(f"bad update frame: {e}")
-        engine.wal_append(nonce, seq, [row])
+        engine.wal_append(nonce, seq, [row], trace=trace)
         verdict = engine.offer(row[1], row[0], row[2],
                                version=(row[3] if len(row) > 3 else None),
-                               poison=(float(row[4]) if len(row) > 4 else 0.0))
+                               poison=(float(row[4]) if len(row) > 4 else 0.0),
+                               trace=trace)
         engine.session_commit(nonce, seq, {verdict: 1})
         return {"op": "ack", "verdict": verdict, "version": engine.version}
     if op == "updates":
@@ -110,14 +139,19 @@ def _handle(engine: ServingEngine, msg: dict) -> dict:
                 f"batch of {len(events)} exceeds "
                 f"MAX_BATCH_EVENTS={protocol.MAX_BATCH_EVENTS}")
         nonce, seq = msg.get("nonce"), msg.get("seq")
-        cached = engine.session_check(nonce, seq, len(events))
+        trace = _frame_trace(msg)
+        engine._trace("client_stamp", trace, op=op,
+                      nonce=(None if nonce is None else str(nonce)),
+                      seq=(None if seq is None else int(seq)),
+                      events=len(events))
+        cached = engine.session_check(nonce, seq, len(events), trace=trace)
         if cached is not None:
             return {"op": "acks", "n": len(events), "counts": cached,
                     "version": engine.version, "tick": engine.tick_count,
                     "duplicate": True}
-        engine.wal_append(nonce, seq, events)
+        engine.wal_append(nonce, seq, events, trace=trace)
         try:
-            counts = engine.offer_many(events)
+            counts = engine.offer_many(events, trace=trace)
         except (TypeError, ValueError, IndexError) as e:
             return protocol.error_msg(f"bad events row: {e}")
         engine.session_commit(nonce, seq, counts)
@@ -161,6 +195,11 @@ def _safe_handle(engine: ServingEngine, msg: Optional[dict], tracer,
         registry.counter("serve_handler_errors").inc()
         tracer.event("serve_handler_error", op=op,
                      error=f"{type(e).__name__}: {e}")
+        # Crash barrier == flight-recorder flush point: the ring (which
+        # now ends with the serve_handler_error above) lands in
+        # events.crash.<role>.jsonl so the failure ships a post-mortem
+        # timeline even though the server itself survives.
+        tracer.flush_crash(reason=f"handler:{op!r}:{type(e).__name__}")
         return protocol.error_msg(
             f"internal error handling {op!r}: {type(e).__name__}: {e}")
 
@@ -175,7 +214,8 @@ def run_server(cfg, *, events: Optional[str] = None,
                verbose: bool = True, handle=None, on_engine=None,
                start_extra: Optional[dict] = None,
                net_fault_plan=None, net_gateway_index: int = 0,
-               net_num_gateways: int = 1) -> dict:
+               net_num_gateways: int = 1,
+               role: Optional[str] = None) -> dict:
     """Serve until SIGTERM (raises ``Preempted`` after the drain) or,
     with ``once=True``, until the first accepted connection closes
     (clean drain, returns the summary). ``cfg`` is a ServingConfig.
@@ -205,7 +245,10 @@ def run_server(cfg, *, events: Optional[str] = None,
 
     registry = default_registry()
     registry.reset()
-    tracer = make_tracer(events)
+    # Role-scoped v2 identity stamp ('serve' default; the gateway fleet
+    # passes 'gateway-<i>') — what lets `fedtpu timeline` / merged
+    # reports key per-process sections even when run_ids collide.
+    tracer = make_tracer(events, role=role or "serve")
     log = TelemetryLogger(verbose=verbose, tracer=tracer)
     engine = ServingEngine(cfg, registry=registry, tracer=tracer)
     if checkpoint_dir:

@@ -20,14 +20,25 @@ schemaless per-round metrics JSONL. This package unifies them:
                 breakdowns, round-cadence percentiles, staleness
                 distributions and counter totals (``fedtpu report``);
                 numpy-only so it runs without a JAX backend
+    timeline  — causal fleet timeline (``fedtpu timeline``): merges N
+                events sinks + netproxy logs + autoscale decision logs
+                into one ordered view, rendered as deterministic
+                (goldenable) JSONL or Chrome/Perfetto trace JSON; the
+                trace_id chains stitch one update's client-stamp ->
+                WAL -> admission -> incorporation path across processes
 
 Everything here is import-light: no module in this package imports jax at
 import time (probes that need it import lazily), so ``fedtpu report`` and
 the tests' synthetic round-trips run without touching a backend.
 """
 
-from fedtpu.telemetry.trace import (EVENT_SCHEMA_VERSION, NullTracer,  # noqa: F401
-                                    Tracer, make_tracer)
+from fedtpu.telemetry.trace import (EVENT_SCHEMA_VERSION,  # noqa: F401
+                                    FlightRecorder, NullTracer, Tracer,
+                                    crash_artifact_path, make_tracer,
+                                    process_identity)
+from fedtpu.telemetry.timeline import (chrome_trace,  # noqa: F401
+                                       deterministic_lines, load_timeline,
+                                       render_timeline, trace_chains)
 from fedtpu.telemetry.metrics import (MetricsRegistry, default_registry,  # noqa: F401
                                       install_compile_probe)
 from fedtpu.telemetry.log import TelemetryLogger  # noqa: F401

@@ -58,11 +58,13 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     is the run's final tally."""
     phases: dict = {}
     round_durs: List[float] = []
+    round_nums: List[int] = []
     round_max = 0
     stale_means: List[float] = []
     manifest = None
     last_counters = None
     run_ids = []
+    identities = []
     newer_schema = 0
     faults: List[dict] = []
     rollbacks: List[dict] = []
@@ -107,6 +109,16 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         rid = e.get("run_id")
         if rid and rid not in run_ids:
             run_ids.append(rid)
+        # v2 identity keying: a merged fleet report must distinguish
+        # sources by (run_id, role, process_index) — gateway sinks
+        # restored from one checkpoint lineage (or pinned test runs)
+        # legitimately COLLIDE on run_id alone. v1 events key as the
+        # (0, 'run') defaults.
+        if rid:
+            ident = (rid, e.get("role") or "run",
+                     int(e.get("process_index") or 0))
+            if ident not in identities:
+                identities.append(ident)
         kind = e.get("kind")
         payload = e.get("payload") or {}
         if kind == "span" and e.get("phase"):
@@ -118,6 +130,7 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             p["max_s"] = max(p["max_s"], d)
         elif kind == "round":
             round_durs.append(float(e.get("dur_s") or 0.0))
+            round_nums.append(int(e.get("round") or 0))
             if e.get("round"):
                 round_max = max(round_max, int(e["round"]))
             if payload.get("staleness_mean") is not None:
@@ -238,6 +251,10 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         "malformed_lines": malformed,
         "newer_schema_events": newer_schema,
         "run_ids": run_ids,
+        "identities": [{"run_id": r, "role": ro, "process_index": p}
+                       for r, ro, p in sorted(identities,
+                                              key=lambda i: (i[1], i[2],
+                                                             i[0]))],
         "manifest": None,
         "phases": {k: {**v, "mean_s": v["total_s"] / v["count"]}
                    for k, v in sorted(phases.items())},
@@ -298,6 +315,45 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         # wiring): schedule digest + comm bytes of the width-1 round.
         if manifest.get("audit"):
             out["static_analysis"] = manifest["audit"]
+    # Device-time attribution (docs/observability.md): join the
+    # manifest's static XLA cost model (flops / bytes accessed of the
+    # width-1 round, orchestration/loop.py manifest wiring) with the
+    # measured per-round durations into per-round MFU / roofline rows.
+    # Without a hardware peak (FEDTPU_PEAK_FLOPS at run time) the rows
+    # still carry achieved FLOP/s and arithmetic intensity — just no
+    # MFU ratio. Pinned reference numbers live in benchmarks/RESULTS.md.
+    prof = (manifest or {}).get("profile")
+    if prof and not prof.get("error"):
+        flops = float(prof.get("flops_per_round") or 0.0)
+        bytes_rw = float(prof.get("bytes_per_round") or 0.0)
+        peak = prof.get("peak_flops")
+        rows = []
+        if flops > 0:
+            for rnd, d in zip(round_nums, round_durs):
+                if d <= 0:
+                    continue
+                row = {"round": rnd, "dur_s": d,
+                       "achieved_flops_per_s": flops / d}
+                if peak:
+                    row["mfu"] = flops / d / float(peak)
+                rows.append(row)
+        out["profile"] = {
+            "flops_per_round": flops,
+            "bytes_per_round": bytes_rw,
+            "arithmetic_intensity": (flops / bytes_rw if bytes_rw
+                                     else None),
+            "peak_flops": (float(peak) if peak else None),
+            "profile_rounds": prof.get("profile_rounds"),
+            "rounds": rows,
+        }
+        if rows:
+            ach = np.asarray([r["achieved_flops_per_s"] for r in rows])
+            out["profile"]["achieved_flops_per_s"] = {
+                "mean": float(ach.mean()), "max": float(ach.max())}
+            if peak:
+                out["profile"]["mfu"] = {
+                    "mean": float(ach.mean() / float(peak)),
+                    "max": float(ach.max() / float(peak))}
     if (faults or rollbacks or exclusions or restarts or gang_restarts
             or collective_hangs or child_exits or preempted_rounds
             or resume_rounds or diverged_at or supervisor_exit
@@ -372,6 +428,12 @@ def render_text(agg: dict) -> str:
                      "fields this reader doesn't know are ignored")
     if agg.get("run_ids"):
         lines.append(f"  run_id: {', '.join(agg['run_ids'])}")
+    idents = agg.get("identities") or []
+    if len(idents) > 1:
+        # More sources than run_ids == the v2 identity did its job:
+        # same-run_id sinks split by (role, process_index).
+        lines.append("  sources: " + ", ".join(
+            f"{i['role']}/p{i['process_index']}" for i in idents))
     man = agg.get("manifest")
     if man:
         lines.append("  manifest: " + ", ".join(
@@ -395,6 +457,30 @@ def render_text(agg: dict) -> str:
             lines.append(f"  {k:<{width}}  total {v['total_s']:9.3f} s  "
                          f"x{v['count']:<5d} mean {v['mean_s']:.4f} s  "
                          f"max {v['max_s']:.4f} s")
+    prof = agg.get("profile")
+    if prof:
+        lines.append("device-time attribution:")
+        ai = prof.get("arithmetic_intensity")
+        lines.append(f"  cost model: {prof['flops_per_round']:.3e} "
+                     f"FLOPs/round, {prof['bytes_per_round']:.3e} B/round"
+                     + (f", intensity {ai:.2f} FLOP/B" if ai else ""))
+        if prof.get("peak_flops"):
+            lines.append(f"  peak: {prof['peak_flops']:.3e} FLOP/s")
+        mfu = prof.get("mfu")
+        ach = prof.get("achieved_flops_per_s")
+        if ach:
+            lines.append(f"  achieved: mean {ach['mean']:.3e} FLOP/s, "
+                         f"max {ach['max']:.3e} FLOP/s"
+                         + (f"  (MFU mean {mfu['mean'] * 100:.2f}%, "
+                            f"max {mfu['max'] * 100:.2f}%)" if mfu else ""))
+        rows = prof.get("rounds") or []
+        for r in rows[:8]:
+            lines.append(f"    round {r['round']}: {r['dur_s']:.4f} s, "
+                         f"{r['achieved_flops_per_s']:.3e} FLOP/s"
+                         + (f", MFU {r['mfu'] * 100:.2f}%"
+                            if r.get("mfu") is not None else ""))
+        if len(rows) > 8:
+            lines.append(f"    ... {len(rows) - 8} more round(s)")
     rounds = agg.get("rounds") or {}
     if rounds.get("count"):
         c = rounds.get("cadence") or {}
@@ -608,7 +694,9 @@ def render_text(agg: dict) -> str:
         lines.append("per-source view:")
         for s in srcs:
             tag = (f" [gateway {s['gateway']}]"
-                   if s.get("gateway") is not None else "")
+                   if s.get("gateway") is not None
+                   else f" [{s['role']}]"
+                   if s.get("role") and s["role"] != "run" else "")
             lines.append(f"  {s['path']}{tag}: {s['events']} event(s)")
             adm = s.get("admission")
             if adm:
@@ -669,6 +757,33 @@ def render_prometheus(agg: dict) -> str:
         for q, key in (("0.5", "p50_s"), ("0.9", "p90_s"),
                        ("0.99", "p99_s")):
             lines.append(f'{n}{{quantile="{q}"}} {srv_lat[key]:g}')
+    # Defense section (fedtpu.robust; docs/robustness.md): screening +
+    # quarantine census. These lived only in the text report before —
+    # a scrape-driven alert ("quarantines > 0") needs them here.
+    defense = (agg.get("serving") or {}).get("defense")
+    if defense:
+        emit("screened_updates_total",
+             defense.get("screened_updates") or 0, "counter")
+        emit("quarantined_users",
+             len(defense.get("quarantined_users") or []), "gauge")
+    # Network section (fedtpu.serving.netproxy): per-gateway wire-fault
+    # firing counts, labeled like the merged fleet view groups them.
+    net = agg.get("network")
+    if net and net.get("per_gateway"):
+        n = _prom_name("net_faults_fired_total")
+        lines.append(f"# TYPE {n} counter")
+        for g, kinds in sorted(net["per_gateway"].items()):
+            lines.append(f'{n}{{gateway="{g}"}} '
+                         f'{sum(kinds.values()):g}')
+    # Device-time attribution: the roofline numbers as gauges, so a
+    # dashboard can trend MFU across runs.
+    prof = agg.get("profile")
+    if prof:
+        emit("model_flops_per_round", prof.get("flops_per_round") or 0,
+             "gauge")
+        if prof.get("mfu"):
+            emit("mfu_mean", prof["mfu"]["mean"], "gauge")
+            emit("mfu_max", prof["mfu"]["max"], "gauge")
     for name, h in sorted((agg.get("histograms") or {}).items()):
         n = _prom_name(name)
         lines.append(f"# TYPE {n} histogram")
@@ -690,8 +805,29 @@ def _source_view(path: str, events: List[dict], bad: int) -> dict:
     summ = srv.get("summary") or srv.get("last_tick") or {}
     signals = summ.get("signals") or {}
     start = srv.get("start") or {}
+    # Gateway identity: the serve_start payload when the run got that
+    # far, else the v2 role stamp ('gateway-<i>') any event carries —
+    # a member that crashed pre-start (or whose run_id collides with a
+    # sibling's) still lands in the right fleet slot.
+    gateway = start.get("gateway")
+    role = None
+    process_index = None
+    for e in events:
+        if role is None and e.get("role"):
+            role = e["role"]
+            process_index = int(e.get("process_index") or 0)
+        if gateway is None and str(e.get("role") or "").startswith(
+                "gateway-"):
+            try:
+                gateway = int(str(e["role"]).rsplit("-", 1)[1])
+            except ValueError:
+                pass
+        if role is not None and gateway is not None:
+            break
     return {"path": path, "events": len(events),
-            "gateway": start.get("gateway"),
+            "gateway": gateway,
+            "role": role or "run",
+            "process_index": process_index or 0,
             "admission": summ.get("admission"),
             "incorporated": summ.get("incorporated"),
             "duplicate_drops": summ.get("duplicate_drops"),

@@ -1,0 +1,201 @@
+"""``fedtpu check --timeline-sim`` — deterministic causal-trace replay.
+
+Replays a PINNED two-gateway campaign (the ``SIM_*`` constants below)
+against two REAL (small) :class:`fedtpu.serving.engine.ServingEngine`
+instances through the real request dispatcher
+(``fedtpu.serving.server._handle``), each engine writing a real
+schema-v2 events sink through a role-scoped
+:class:`fedtpu.telemetry.trace.Tracer` — then merges the two sinks with
+:mod:`fedtpu.telemetry.timeline` and compares the deterministic JSONL
+rendering bitwise against the committed golden
+(``tests/goldens/timeline_sim.jsonl``), reusing the autoscale control
+plane's write/compare machinery like the net/defense/audit gates.
+
+The campaign includes a DELIBERATE retry: one frame is re-sent with its
+original idempotency stamp, so the golden pins the full exactly-once
+causal story under one trace_id — client_stamp -> wal -> admit ->
+buffer_insert on the first delivery, client_stamp -> dedup_drop on the
+retry, incorporate at the drain tick — across two gateway processes.
+Any silent change to the trace-id derivation, the stage emission
+points, the dedup path, or the timeline canonicalization moves these
+bytes and turns into a reviewed golden regeneration instead of an
+accident.
+
+Like the net sim this touches jax (engine ticks are real), so it only
+runs when explicitly invoked — never at import.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+# One write/compare implementation repo-wide (see net_sim.py): the
+# golden gates must never drift in format or failure reporting.
+from fedtpu.autoscale.controller import compare_decisions, write_decisions
+
+# ---------------------------------------------------------------------------
+# Simulation contract: these constants are part of the committed golden
+# (tests/goldens/timeline_sim.jsonl). Changing ANY of them — or the
+# trace-id derivation in serving/protocol.py, the stage emission in
+# serving/engine.py / serving/server.py, the v2 event schema in
+# telemetry/trace.py, or the canonicalization in telemetry/timeline.py
+# — legitimately regenerates the golden via
+# ``python -m fedtpu.telemetry.timeline_sim --write <path>``.
+
+SIM_USERS = 16
+SIM_ARRIVALS = 64
+SIM_HORIZON_S = 8.0
+SIM_SEED = 7
+SIM_BATCH = 8                       # trace rows per global chunk
+SIM_GATEWAYS = 2
+SIM_COHORT = 8
+SIM_BUFFER = 2
+SIM_TICK_INTERVAL_S = 0.5
+# The session nonce is pinned (a live client draws a uuid), and the
+# retried frame is pinned by its seq: determinism.
+SIM_NONCE = "tlsim0campaign42"
+SIM_RETRY_SEQ = 3
+SIM_RUN_IDS = ("tlsim0g0", "tlsim0g1")
+
+
+def _sim_config():
+    from fedtpu.config import ServingConfig
+    return ServingConfig(
+        cohort=SIM_COHORT, buffer_size=SIM_BUFFER,
+        tick_interval_s=SIM_TICK_INTERVAL_S,
+        data_rows=64, model_hidden=(8,), seed=0)
+
+
+def simulate(events_dir=None) -> dict:
+    """Replay the pinned campaign. Returns ``{"lines": [...],
+    "summary": {...}}`` where ``lines`` is the merged deterministic
+    timeline JSONL and ``summary`` scores the campaign: per-gateway
+    incorporation/dedup totals, chain count, and the retried trace_id's
+    stage sequence (the acceptance chain).
+
+    ``events_dir``: where the two sinks are written; a temp dir (cleaned
+    up) when None. The dir name never reaches the golden — the
+    deterministic renderer labels sources by role, not path."""
+    from fedtpu.serving import protocol
+    from fedtpu.serving.engine import ServingEngine
+    from fedtpu.serving.server import _handle
+    from fedtpu.serving.traces import synthesize_trace
+    from fedtpu.telemetry.metrics import MetricsRegistry
+    from fedtpu.telemetry.timeline import (deterministic_lines,
+                                           load_timeline, trace_chains)
+    from fedtpu.telemetry.trace import Tracer
+
+    tmp = None
+    if events_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="fedtpu_tlsim_")
+        events_dir = tmp.name
+    try:
+        paths = [os.path.join(events_dir, f"events.g{g}.jsonl")
+                 for g in range(SIM_GATEWAYS)]
+        tracers = [Tracer(paths[g], run_id=SIM_RUN_IDS[g],
+                          role=f"gateway-{g}", process_index=g)
+                   for g in range(SIM_GATEWAYS)]
+        engines = [ServingEngine(_sim_config(), registry=MetricsRegistry(),
+                                 tracer=tracers[g])
+                   for g in range(SIM_GATEWAYS)]
+        for g, eng in enumerate(engines):
+            # Real WAL (the gateway wiring's per-member path) so every
+            # chain carries its gateway-WAL leg — the acceptance chain
+            # is client_stamp -> wal -> ... -> incorporate.
+            eng.wal_path = os.path.join(events_dir, f"wal.g{g}.jsonl")
+
+        _, t, user, lat = synthesize_trace(
+            SIM_USERS, SIM_ARRIVALS, SIM_HORIZON_S, seed=SIM_SEED)
+        rows = [[int(user[i]), float(t[i]), float(lat[i])]
+                for i in range(len(t))]
+
+        # GatewayClient semantics: ONE session nonce, a GLOBAL seq, each
+        # chunk partitioned by the ownership rule (user % num_gateways)
+        # into one stamped frame per owning gateway. Frames are stamped
+        # ONCE — the deliberate retry below re-sends the frame verbatim.
+        for g in range(SIM_GATEWAYS):
+            _handle(engines[g],
+                    {"op": "hello", "v": protocol.PROTOCOL_VERSION,
+                     "nonce": SIM_NONCE,
+                     "trace": protocol.trace_id(SIM_NONCE, 0)})
+        seq = 0
+        frames = []                 # (gateway, frame) in send order
+        for i in range(0, len(rows), SIM_BATCH):
+            chunk = rows[i:i + SIM_BATCH]
+            for g in range(SIM_GATEWAYS):
+                owned = [r for r in chunk if r[0] % SIM_GATEWAYS == g]
+                if not owned:
+                    continue
+                seq += 1
+                frames.append((g, {
+                    "op": "updates", "events": owned,
+                    "nonce": SIM_NONCE, "seq": seq,
+                    "trace": protocol.trace_id(SIM_NONCE, seq)}))
+        retry = next((f for f in frames if f[1]["seq"] == SIM_RETRY_SEQ),
+                     frames[0])
+        for g, frame in frames:
+            _handle(engines[g], frame)
+        # The retry: same stamp, same trace — the engine must answer
+        # with the original verdict and the chain must gain ONLY a
+        # client_stamp + dedup_drop leg under the SAME trace_id.
+        dup = _handle(engines[retry[0]], retry[1])
+        drains = [_handle(engines[g], {"op": "drain"})
+                  for g in range(SIM_GATEWAYS)]
+        for tr in tracers:
+            tr.close()
+
+        sources = load_timeline(paths)
+        lines = deterministic_lines(sources)
+        chains = trace_chains(sources)
+        retry_tid = protocol.trace_id(SIM_NONCE, int(retry[1]["seq"]))
+        retry_chain = next((c for c in chains if c["chain"] == retry_tid),
+                           None)
+        summary = {
+            "arrivals": len(rows),
+            "frames": len(frames),
+            "chains": len(chains),
+            "retry_duplicate": bool(dup.get("duplicate", False)),
+            "retry_trace": retry_tid,
+            "retry_stages": ([s["stage"] for s in retry_chain["stages"]]
+                             if retry_chain else []),
+            "incorporated": [int(d.get("incorporated", 0))
+                             for d in drains],
+            "duplicate_drops": [int(e.duplicate_drops) for e in engines],
+        }
+        return {"lines": lines, "summary": summary}
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def main(argv=None) -> int:
+    """Regenerate or check the golden:
+    ``python -m fedtpu.telemetry.timeline_sim --write tests/goldens/...``
+    """
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--write", metavar="PATH", default=None,
+                    help="write the canonical timeline JSONL here")
+    ap.add_argument("--golden", metavar="PATH", default=None,
+                    help="compare against this golden; exit 1 on mismatch")
+    args = ap.parse_args(argv)
+    sim = simulate()
+    if args.write:
+        write_decisions(args.write, sim["lines"])
+        print(f"wrote {len(sim['lines'])} timeline lines -> {args.write}")  # fedtpu: noqa[FTP005] golden-regen CLI entry point
+    if args.golden:
+        res = compare_decisions(sim["lines"], args.golden)
+        print(("OK: " if res["ok"] else "MISMATCH: ") + res["reason"])  # fedtpu: noqa[FTP005] golden-regen CLI entry point
+        return 0 if res["ok"] else 1
+    if not args.write:
+        for line in sim["lines"]:
+            print(line)  # fedtpu: noqa[FTP005] golden-regen CLI entry point
+    return 0
+
+
+__all__ = ["simulate", "write_decisions", "compare_decisions",
+           "SIM_NONCE", "SIM_SEED", "SIM_RETRY_SEQ", "SIM_GATEWAYS"]
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,17 +1,32 @@
 """Span/event tracer with a versioned JSONL sink.
 
-Event schema (``EVENT_SCHEMA_VERSION = 1``) — one JSON object per line:
+Event schema (``EVENT_SCHEMA_VERSION = 2``) — one JSON object per line:
 
-    v        int    schema version
-    run_id   str    one uuid4 hex per tracer (joins every event of a run)
-    kind     str    'manifest' | 'span' | 'round' | 'counters' | 'log' | ...
-    phase    str?   span phase label ('build', 'compile', 'chunk', 'eval',
-                    'checkpoint', 'stop_check', 'personalize', 'launch', ...)
-    round    int?   1-based round (tick) the event belongs to, when any
-    t_start  float  seconds since the tracer's epoch (time.monotonic-based,
-                    so deltas are immune to wall-clock steps)
-    dur_s    float  span duration; 0.0 for instantaneous events
-    payload  dict   kind-specific data (metric values, counter snapshots...)
+    v              int    schema version
+    run_id         str    one uuid4 hex per tracer (joins every event of a run)
+    kind           str    'manifest' | 'span' | 'round' | 'counters' | 'log' |
+                          'trace' | ...
+    phase          str?   span phase label ('build', 'compile', 'chunk',
+                          'eval', 'checkpoint', 'stop_check', 'personalize',
+                          'launch', ...); for kind 'trace' the causal stage
+                          ('client_stamp', 'wal', 'admit', 'buffer_insert',
+                          'dedup_drop', 'incorporate')
+    round          int?   1-based round (tick) the event belongs to, when any
+    t_start        float  seconds since the tracer's epoch (time.monotonic-
+                          based, so deltas are immune to wall-clock steps)
+    dur_s          float  span duration; 0.0 for instantaneous events
+    process_index  int    fleet process identity (v2): FEDTPU_PROCESS_ID or 0
+    pid            int    OS pid of the emitting process (v2)
+    launch_id      str?   gang launch id (FEDTPU_LAUNCH_ID) when one (v2)
+    role           str    emitting role (v2): 'run', 'serve', 'gateway-<i>',
+                          'proxy-<i>', 'supervisor', ...
+    payload        dict   kind-specific data (metric values, counters...)
+
+v1 files (no identity fields) stay readable: every consumer reads the
+identity with defaults (``process_index=0``, ``role='run'``), so old
+sinks parse unchanged and merged multi-process reports key sections on
+``(run_id, role, process_index)`` instead of the colliding ``run_id``
+alone.
 
 Timing rule, inherited from fedtpu.utils.timing's round-1 postmortem:
 ``jax.block_until_ready`` does NOT synchronize on this platform's remote
@@ -20,6 +35,13 @@ Timing rule, inherited from fedtpu.utils.timing's round-1 postmortem:
 ``Span.end_after_fetch`` packages that rule; the round loop closes its
 chunk spans on the batched metrics materialization, which is the same
 proof.
+
+Crash flight recorder: every Tracer keeps a bounded in-memory ring of
+its most recent event lines (``FlightRecorder``). The supervisor's
+0/3/75 exit paths and the serving crash barrier (``_safe_handle``)
+flush it to ``events.crash.<role>.jsonl`` next to the events sink, so a
+chaos-row failure always ships a post-mortem timeline even when the
+main sink is on a dead disk or got truncated mid-crash.
 
 Writes flush per event: a crashed run's sink still holds everything
 emitted before the crash (the tracer exists precisely to diagnose such
@@ -31,12 +53,84 @@ and the tests' synthetic emitters must work backend-free.
 
 from __future__ import annotations
 
+import collections
 import json
+import os
 import time
 import uuid
 from typing import Optional
 
-EVENT_SCHEMA_VERSION = 1
+EVENT_SCHEMA_VERSION = 2
+
+# Ring capacity of the per-process crash flight recorder: enough for the
+# serving fleet's last few ticks of context without holding a long run's
+# whole history in memory.
+FLIGHT_RECORDER_CAPACITY = 256
+
+
+def process_identity(role: Optional[str] = None,
+                     process_index: Optional[int] = None) -> dict:
+    """The v2 identity stamp for this process. ``process_index`` falls
+    back to the gang supervisor's FEDTPU_PROCESS_ID contract
+    (fedtpu.resilience.distributed), ``launch_id`` to FEDTPU_LAUNCH_ID —
+    both absent on a plain single-process run, which stamps as the
+    canonical (0, 'run')."""
+    if process_index is None:
+        try:
+            process_index = int(os.environ.get("FEDTPU_PROCESS_ID", "0") or 0)
+        except ValueError:
+            process_index = 0
+    return {"process_index": int(process_index), "pid": os.getpid(),
+            "launch_id": os.environ.get("FEDTPU_LAUNCH_ID"),
+            "role": role or "run"}
+
+
+def crash_artifact_path(events_path: Optional[str], role: str) -> str:
+    """Path of the flight-recorder flush target for ``role``:
+    ``events.crash.<role>.jsonl`` in the events sink's directory (the
+    cwd when the tracer has no sink)."""
+    base = os.path.dirname(events_path) if events_path else "."
+    return os.path.join(base or ".", f"events.crash.{role}.jsonl")
+
+
+class FlightRecorder:
+    """Bounded ring of the most recent serialized event lines.
+
+    Append-only and O(1) per event (collections.deque with maxlen); the
+    whole point is that recording must be cheap enough to run on EVERY
+    event of a healthy process that will probably never crash."""
+
+    def __init__(self, capacity: int = FLIGHT_RECORDER_CAPACITY):
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity)
+
+    def record(self, line: str) -> None:
+        self._ring.append(line)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def lines(self) -> list:
+        return list(self._ring)
+
+    def flush(self, path: str) -> int:
+        """Write the ring to ``path`` (overwrite: the LAST crash of a
+        process is the one worth keeping) and return the line count.
+        Never raises — the flight recorder runs inside crash paths where
+        a secondary I/O error must not mask the primary failure."""
+        lines = self.lines()
+        if not lines:
+            return 0
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                for line in lines:
+                    fh.write(line + "\n")
+            os.replace(tmp, path)
+        except OSError:
+            return 0
+        return len(lines)
 
 
 class Span:
@@ -78,12 +172,18 @@ class Span:
 
 
 class Tracer:
-    """Appends schema-v1 events to a JSONL sink. One per run; all
-    timestamps are seconds since this tracer's construction (monotonic)."""
+    """Appends schema-v2 events to a JSONL sink. One per run; all
+    timestamps are seconds since this tracer's construction (monotonic).
+    Every emitted line also lands in the in-memory flight recorder."""
 
-    def __init__(self, path: str, run_id: Optional[str] = None):
+    def __init__(self, path: str, run_id: Optional[str] = None,
+                 role: Optional[str] = None,
+                 process_index: Optional[int] = None):
         self.path = path
         self.run_id = run_id or uuid.uuid4().hex
+        self.identity = process_identity(role, process_index)
+        self.role = self.identity["role"]
+        self.flight = FlightRecorder()
         self._epoch = time.monotonic()
         self._f = open(path, "a")
 
@@ -106,8 +206,10 @@ class Tracer:
                "kind": kind, "phase": phase, "round": round,
                "t_start": (self._now() - dur_s if t_start is None
                            else t_start),
-               "dur_s": dur_s, "payload": payload}
-        self._f.write(json.dumps(rec, default=_json_default) + "\n")
+               "dur_s": dur_s, **self.identity, "payload": payload}
+        line = json.dumps(rec, default=_json_default)
+        self.flight.record(line)
+        self._f.write(line + "\n")
         self._f.flush()
 
     def span(self, phase: str, round: Optional[int] = None,
@@ -118,6 +220,19 @@ class Tracer:
         """Emit a full registry snapshot (kind 'counters'). The report's
         counter totals come from the LAST such event in the log."""
         self.event("counters", **snapshot)
+
+    def flush_crash(self, reason: str = "") -> Optional[str]:
+        """Flush the flight recorder to ``events.crash.<role>.jsonl``
+        next to the sink; returns the artifact path (None when the ring
+        was empty). Called from crash barriers — never raises."""
+        path = crash_artifact_path(self.path, self.role)
+        if reason:
+            self.flight.record(json.dumps(
+                {"v": EVENT_SCHEMA_VERSION, "run_id": self.run_id,
+                 "kind": "crash_flush", "phase": None, "round": None,
+                 "t_start": self._now(), "dur_s": 0.0, **self.identity,
+                 "payload": {"reason": reason}}, default=_json_default))
+        return path if self.flight.flush(path) else None
 
     def close(self) -> None:
         if not self._f.closed:
@@ -151,6 +266,11 @@ class NullTracer:
 
     path = None
     run_id = None
+    role = "run"
+
+    def __init__(self):
+        self.identity = process_identity()
+        self.flight = FlightRecorder(capacity=1)
 
     @property
     def enabled(self) -> bool:
@@ -165,6 +285,9 @@ class NullTracer:
 
     def counters(self, snapshot) -> None:
         pass
+
+    def flush_crash(self, reason: str = "") -> Optional[str]:
+        return None
 
     def close(self) -> None:
         pass
@@ -181,7 +304,14 @@ def _json_default(obj):
     return repr(obj)
 
 
-def make_tracer(path: Optional[str], run_id: Optional[str] = None):
+def make_tracer(path: Optional[str], run_id: Optional[str] = None,
+                role: Optional[str] = None,
+                process_index: Optional[int] = None):
     """The one constructor call sites use: a real ``Tracer`` when ``path``
-    is set (process 0 of a run), a ``NullTracer`` otherwise."""
-    return Tracer(path, run_id=run_id) if path else NullTracer()
+    is set, a ``NullTracer`` otherwise. ``role`` scopes the v2 identity
+    stamp ('run' default; the gateway fleet passes 'gateway-<i>', the
+    supervisor 'supervisor') so merged fleet timelines can key sections
+    on something better than a colliding run_id."""
+    return (Tracer(path, run_id=run_id, role=role,
+                   process_index=process_index)
+            if path else NullTracer())

@@ -75,6 +75,16 @@ def program_flops(compiled) -> float:
     return float(cost.get("flops", 0.0))
 
 
+def program_bytes_accessed(compiled) -> float:
+    """Bytes accessed from an executable's XLA cost analysis (0.0 when
+    absent) — the roofline denominator's memory side: flops / bytes is
+    the program's arithmetic intensity (docs/observability.md)."""
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return float(cost.get("bytes accessed", 0.0))
+
+
 def compile_with_flops(step, *args, cache=None, key=None):
     """AOT-compile a jitted program once; return ``(compiled, flops)``.
 

@@ -164,6 +164,13 @@ QUICK_TESTS = {
     # round-4 modules
     # telemetry subsystem (tracer/report/satellites; backend-free picks)
     "test_telemetry.py::test_event_schema_roundtrip",
+    # causal fleet tracing (docs/observability.md): trace_id/flight
+    # recorder/merged identity keying are backend-free milliseconds;
+    # the sim golden gate stays full-tier (it compiles the engines).
+    "test_timeline.py::test_trace_id_deterministic_across_retry",
+    "test_timeline.py::test_flight_recorder_ring_bounds",
+    "test_timeline.py::test_merged_report_keys_colliding_run_ids",
+    "test_timeline.py::test_timeline_merges_and_orders_chains",
     "test_telemetry.py::test_bench_json_is_last_stdout_line",
     "test_telemetry.py::test_drop_nonwinning_weights_frees_losers",
     "test_telemetry.py::test_no_bare_prints_outside_allowlist",

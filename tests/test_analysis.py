@@ -245,6 +245,40 @@ FIXTURES = {
                 return s
             """,
     },
+    "FTP010": {
+        "positive": """
+            import jax, time
+            step = jax.jit(lambda s, b: s)
+            def bench(state, batch):
+                t0 = time.perf_counter()
+                state = step(state, batch)
+                t1 = time.perf_counter()   # delta times the enqueue only
+                return t1 - t0
+            """,
+        "negative": """
+            import jax, time
+            step = jax.jit(lambda s, b: s)
+            def bench(state, batch):
+                t0 = time.perf_counter()
+                state = jax.block_until_ready(step(state, batch))
+                t1 = time.perf_counter()   # synced: times real compute
+                return t1 - t0
+            def stamp(log):
+                t0 = time.time()
+                log.info("no device work between the reads")
+                t1 = time.time()
+                return t1 - t0
+            """,
+        "suppressed": """
+            import jax, time
+            step = jax.jit(lambda s, b: s)
+            def bench(state, batch):
+                t0 = time.perf_counter()
+                state = step(state, batch)
+                t1 = time.perf_counter()  # fedtpu: noqa[FTP010] fixture
+                return t1 - t0
+            """,
+    },
     "FTP101": {
         "positive": """
             def f(xs=[]):

@@ -237,6 +237,7 @@ def test_every_documented_flag_exists_in_the_parser():
                    "--trace",                      # benchmarks/async_bench.py
                    "--scale", "--total-clients",   # benchmarks/scaling.py
                    "--store",                      # benchmarks/scaling.py
+                   "--write",     # python -m fedtpu.telemetry.timeline_sim
                    "--xla_force_host_platform_device_count",  # XLA flag
                    "--hostfile", "--np"}           # mpirun (reference docs)
     missing = documented - known - other_tools

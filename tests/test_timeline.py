@@ -1,0 +1,257 @@
+"""Causal fleet tracing (docs/observability.md): schema-v2 identity and
+v1 back-compat, the timeline merger + deterministic renderer, trace_id
+determinism across retries, the crash flight recorder (ring bounds,
+tracer flush, the supervisor's exit-75 flush), the merged-report
+identity keying over colliding run_ids, and the defense/network/MFU
+Prometheus export.
+
+The committed golden ``tests/goldens/timeline_sim.jsonl`` is gated here
+(and by ``fedtpu check --timeline-sim``): the pinned two-gateway
+campaign replayed through the REAL serving engines must render
+bitwise-identically — one retried update must read as a single trace_id
+whose chain shows client_stamp -> wal -> admit -> buffer_insert ->
+incorporate and then the retry's client_stamp -> dedup_drop.
+"""
+
+import json
+import os
+import sys
+
+from fedtpu.serving import protocol
+from fedtpu.telemetry.report import aggregate, render_prometheus, render_text
+from fedtpu.telemetry.timeline import (STAGES, chrome_trace,
+                                       default_artifacts,
+                                       deterministic_lines, load_timeline,
+                                       trace_chains)
+from fedtpu.telemetry.trace import (FLIGHT_RECORDER_CAPACITY, FlightRecorder,
+                                    NullTracer, Tracer, crash_artifact_path)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ trace_id
+
+def test_trace_id_deterministic_across_retry():
+    """A retry resends the SAME (nonce, seq) stamp, so it must map to the
+    same trace_id — that equality is what folds the retry into the
+    original update's causal chain."""
+    tid = protocol.trace_id("client-nonce-7", 3)
+    assert tid == protocol.trace_id("client-nonce-7", 3)      # the retry
+    assert len(tid) == 16 and int(tid, 16) >= 0               # hex, stable width
+    assert tid != protocol.trace_id("client-nonce-7", 4)      # next frame
+    assert tid != protocol.trace_id("client-nonce-8", 3)      # other client
+    # numeric-string seq normalizes like the int (wire JSON roundtrip)
+    assert tid == protocol.trace_id("client-nonce-7", "3")
+
+
+# -------------------------------------------------- v1 -> v2 back-compat
+
+def _v1_line(kind, rnd=None, payload=None):
+    return {"v": 1, "run_id": "oldrun", "kind": kind, "phase": None,
+            "round": rnd, "t_start": 0.5, "dur_s": 0.1,
+            "payload": payload or {}}
+
+
+def test_v1_events_read_with_identity_defaults(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    with open(p, "w") as fh:
+        for r in range(3):
+            fh.write(json.dumps(_v1_line("round", rnd=r)) + "\n")
+    src, = load_timeline([str(p)])
+    assert src["type"] == "events" and src["label"] == "run"
+    agg = aggregate(src["records"], src["malformed"])
+    assert agg["identities"] == [
+        {"run_id": "oldrun", "role": "run", "process_index": 0}]
+    assert agg["rounds"]["count"] == 3
+    # single-source report: no fleet "sources:" line in the text view
+    assert "sources:" not in render_text(agg)
+
+
+# -------------------------------------- merged report / colliding run_ids
+
+def _v2_line(kind, role, pidx, rnd=None, payload=None, rid="sharedrun"):
+    return {"v": 2, "run_id": rid, "kind": kind, "round": rnd,
+            "t_start": 1.0, "dur_s": 0.01, "process_index": pidx,
+            "pid": 1234, "launch_id": "L0", "role": role,
+            "payload": payload or {}}
+
+
+def _fleet_events():
+    """Two gateways restored from one lineage: run_id COLLIDES, only the
+    v2 (role, process_index) identity tells them apart."""
+    ev = []
+    for r in range(2):
+        ev.append(_v2_line("round", "run", 0, rnd=r))
+    for g in (0, 1):
+        ev.append(_v2_line("serve_tick", f"gateway-{g}", g, rnd=1,
+                           payload={"version": 1}))
+        ev.append(_v2_line("serve_screened", f"gateway-{g}", g, rnd=1,
+                           payload={"n_screened": 2 + g}))
+        ev.append(_v2_line("net_fault", f"gateway-{g}", g,
+                           payload={"gateway": g, "fault": "drop_frame"}))
+    ev.append(_v2_line("serve_quarantine", "gateway-0", 0, rnd=2,
+                       payload={"user": 5, "strikes": 3}))
+    return ev
+
+
+def test_merged_report_keys_colliding_run_ids():
+    agg = aggregate(_fleet_events())
+    assert agg["run_ids"] == ["sharedrun"]          # the collision
+    idents = [(i["role"], i["process_index"]) for i in agg["identities"]]
+    assert idents == [("gateway-0", 0), ("gateway-1", 1), ("run", 0)]
+    txt = render_text(agg)
+    assert "sources: gateway-0/p0, gateway-1/p1, run/p0" in txt
+
+
+def test_prometheus_exports_defense_and_network():
+    prom = render_prometheus(aggregate(_fleet_events()))
+    assert "fedtpu_screened_updates_total 5" in prom          # 2 + 3
+    assert "fedtpu_quarantined_users 1" in prom
+    assert 'fedtpu_net_faults_fired_total{gateway="0"} 1' in prom
+    assert 'fedtpu_net_faults_fired_total{gateway="1"} 1' in prom
+
+
+# -------------------------------------------------- flight recorder ring
+
+def test_flight_recorder_ring_bounds(tmp_path):
+    fr = FlightRecorder()
+    for i in range(3 * FLIGHT_RECORDER_CAPACITY):
+        fr.record(f"line-{i}")
+    assert len(fr) == FLIGHT_RECORDER_CAPACITY        # bounded
+    lines = fr.lines()
+    assert lines[0] == f"line-{2 * FLIGHT_RECORDER_CAPACITY}"  # oldest kept
+    assert lines[-1] == f"line-{3 * FLIGHT_RECORDER_CAPACITY - 1}"
+    out = tmp_path / "crash.jsonl"
+    assert fr.flush(str(out)) == FLIGHT_RECORDER_CAPACITY
+    assert out.read_text().splitlines() == lines
+    # flush never raises from a crash path — bad target returns 0
+    assert fr.flush(str(tmp_path / "no" / "such" / "dir" / "x")) == 0
+    assert FlightRecorder().flush(str(tmp_path / "empty.jsonl")) == 0
+    assert not (tmp_path / "empty.jsonl").exists()    # empty ring: no file
+
+
+def test_tracer_flush_crash_writes_artifact(tmp_path):
+    ev = tmp_path / "events.jsonl"
+    tr = Tracer(str(ev), role="serve")
+    try:
+        tr.event("serve_tick", round=1, version=2)
+        path = tr.flush_crash(reason="handler:boom")
+    finally:
+        tr.close()
+    assert path == crash_artifact_path(str(ev), "serve")
+    assert path.endswith("events.crash.serve.jsonl")
+    recs = [json.loads(l) for l in open(path)]
+    assert recs[0]["kind"] == "serve_tick" and recs[0]["role"] == "serve"
+    assert recs[-1]["kind"] == "crash_flush"
+    assert recs[-1]["payload"]["reason"] == "handler:boom"
+    assert all(r["v"] == 2 for r in recs)
+    assert NullTracer().flush_crash(reason="x") is None   # telemetry off
+
+
+def test_supervisor_flushes_flight_recorder_on_exit_75(tmp_path):
+    """A child that keeps exiting 75 (preempted) with the restart budget
+    at zero takes the supervisor's budget_exhausted exit path — which
+    must leave the post-mortem events.crash.supervisor.jsonl behind."""
+    from fedtpu.resilience.supervisor import supervise
+    ev = tmp_path / "ev.jsonl"
+    rc = supervise(["unused-arg"], max_restarts=0, backoff_base=0.01,
+                   backoff_max=0.02, events=str(ev), verbose=False,
+                   _cmd_prefix=[sys.executable, "-c", "import sys; sys.exit(75)"])
+    assert rc == 75
+    crash = tmp_path / "events.crash.supervisor.jsonl"
+    assert crash.exists() and crash.stat().st_size > 0
+    recs = [json.loads(l) for l in open(crash)]
+    assert recs[-1]["kind"] == "crash_flush"
+    assert recs[-1]["payload"]["reason"] == "budget_exhausted:rc=75"
+    assert any(r["kind"] == "child_exit" for r in recs)
+    assert all(r.get("role") == "supervisor" for r in recs)
+
+
+# ------------------------------------------------------- timeline merger
+
+def _trace_line(role, pidx, stage, tid, rnd, **extra):
+    line = _v2_line("trace", role, pidx, rnd=rnd,
+                    payload={"trace_id": tid, **extra})
+    line["phase"] = stage       # the causal stage rides the phase field
+    return line
+
+
+def test_timeline_merges_and_orders_chains(tmp_path):
+    tid = protocol.trace_id("nonce", 0)
+    gw = tmp_path / "ev.jsonl.g0"
+    with open(gw, "w") as fh:
+        # Written out of causal order on purpose: the chain must sort by
+        # (tick, stage rank), not file position.
+        fh.write(json.dumps(_trace_line("gateway-0", 0, "incorporate",
+                                        tid, 2)) + "\n")
+        fh.write(json.dumps(_trace_line("gateway-0", 0, "client_stamp",
+                                        tid, 1, user=4, seq=0)) + "\n")
+        fh.write(json.dumps(_trace_line("gateway-0", 0, "wal",
+                                        tid, 1)) + "\n")
+    net = tmp_path / "ev.jsonl.g0.netlog"
+    with open(net, "w") as fh:
+        fh.write(json.dumps({"gateway": 0, "seed": 7, "digest": "d"}) + "\n")
+        fh.write(json.dumps({"summary": {"frames": 1}}) + "\n")
+    dec = tmp_path / "decisions.jsonl"
+    with open(dec, "w") as fh:
+        fh.write(json.dumps({"v": 1, "version": 3, "t": 0.5,
+                             "decisions": [{"kind": "scale_up"}]}) + "\n")
+
+    sources = load_timeline([str(dec), str(net), str(gw)])
+    assert [s["label"] for s in sources] == ["autoscale", "gateway-0",
+                                             "proxy-0"]
+    assert [s["type"] for s in sources] == ["decisions", "events", "netlog"]
+
+    chains = trace_chains(sources)
+    assert len(chains) == 1 and chains[0]["chain"] == tid
+    assert [s["stage"] for s in chains[0]["stages"]] == [
+        "client_stamp", "wal", "incorporate"]
+    assert all(s["stage"] in STAGES for s in chains[0]["stages"])
+
+    lines = deterministic_lines(sources)
+    rows = [json.loads(l) for l in lines]
+    headers = [r for r in rows if "source" in r]
+    assert [(h["source"], h["records"]) for h in headers] == [
+        ("autoscale", 1), ("gateway-0", 3), ("proxy-0", 2)]
+    # goldenability: no wall-clock or process accidents survive
+    for r in rows:
+        for banned in ("t_start", "dur_s", "pid", "run_id", "launch_id"):
+            assert banned not in r
+        assert str(tmp_path) not in json.dumps(r)     # no paths leak
+    assert lines == deterministic_lines(load_timeline(
+        [str(gw), str(dec), str(net)]))               # argv-order stable
+
+    trace = chrome_trace(sources)
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "M"}
+    assert names == {"process_name"}
+    phs = {e["ph"] for e in trace["traceEvents"]}
+    assert "s" in phs and "f" in phs                  # flow arrows stitched
+
+    expanded = default_artifacts(str(tmp_path / "ev.jsonl"))
+    assert str(gw) in expanded and str(net) in expanded
+
+
+# ------------------------------------------------- the tier-1 golden gate
+
+def test_timeline_sim_matches_committed_golden():
+    """The pinned two-gateway campaign replayed through the REAL serving
+    engines (client stamps, gateway WAL, session dedup, K-buffer,
+    incorporation) must render bitwise-identically to the committed
+    golden — the gate over the whole causal-tracing chain."""
+    from fedtpu.telemetry.timeline_sim import compare_decisions, simulate
+    sim = simulate()
+    cmp = compare_decisions(
+        sim["lines"],
+        os.path.join(REPO, "tests", "goldens", "timeline_sim.jsonl"))
+    assert cmp["ok"], cmp["reason"]
+    s = sim["summary"]
+    assert s["retry_duplicate"]
+    # The retried frame's single trace_id reads as one causal chain:
+    # the original pass ends in incorporate, the retry in dedup_drop.
+    stages = s["retry_stages"]
+    for stage in ("client_stamp", "wal", "admit", "buffer_insert",
+                  "incorporate", "dedup_drop"):
+        assert stage in stages, (stage, stages)
+    assert stages.index("incorporate") < stages.index("dedup_drop")
+    assert sum(s["incorporated"]) == s["arrivals"]    # exactly-once
+    assert sum(s["duplicate_drops"]) >= 1
