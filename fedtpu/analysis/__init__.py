@@ -12,14 +12,22 @@ Two halves:
         FTP006  jit wrapper rebuilt per loop iteration / per call
         FTP009  socket.socket()/create_connection() without a timeout
         FTP010  wall-clock pair timing a jitted call without a device sync
+        FTP011  cross-thread shared state with no common lock / Event
+                barrier (interprocedural; callgraph + concurrency)
+        FTP012  signal handlers reaching non-reentrant operations
+        FTP013  nondeterminism taint into canonical json.dumps sinks
         FTP101  mutable default arguments
         FTP102  broad except that swallows all errors
         Suppress per line with ``# fedtpu: noqa[FTP001] <justification>``.
 
-    guards — runtime complements (``fedtpu check``): a ``guards()``
-        context manager scoping jax.transfer_guard / jax_debug_nans, and
-        ``RecompileSentinel``, which counts backend compiles during
-        steady-state round-stepping (after warmup that count must be 0).
+    guards / lockdep — runtime complements (``fedtpu check``): a
+        ``guards()`` context manager scoping jax.transfer_guard /
+        jax_debug_nans, ``RecompileSentinel``, which counts backend
+        compiles during steady-state round-stepping (after warmup that
+        count must be 0), and the lock-order sanitizer
+        (``fedtpu check --lockdep``): TrackedLock drills over the
+        threaded subsystems whose acquisition-order graph is compared
+        bitwise against tests/goldens/lockdep.json.
 
 A third, IR-level half (``fedtpu audit``; docs/analysis.md "Program
 audit"): collectives / program walk the traced jaxpr of the real round
@@ -37,7 +45,8 @@ from fedtpu.analysis.engine import (Finding, LintResult, RULES,  # noqa: F401
 # Importing the rule modules registers every FTP checker, so lint_source
 # works directly for any importer of the package (not just lint_paths,
 # which also imports them lazily).
-from fedtpu.analysis import rules_generic, rules_jax  # noqa: F401
+from fedtpu.analysis import (concurrency, determinism,  # noqa: F401
+                             rules_generic, rules_jax)
 from fedtpu.analysis.guards import (RecompileSentinel, RetraceError,  # noqa: F401
                                     guards)
 from fedtpu.analysis.reporters import render_json, render_text  # noqa: F401

@@ -1,0 +1,485 @@
+"""Module-resolving call graph + thread-root inventory.
+
+The substrate the interprocedural rules (FTP011/FTP012, fedtpu/analysis/
+concurrency.py) flow facts over. One :class:`ModuleGraph` per module:
+
+- every function/method with its resolved in-module call edges (bare
+  names resolve to module functions or sibling nested defs; ``self.m``
+  / ``cls.m`` resolve to methods of the enclosing class);
+- the **thread-root inventory**: every ``threading.Thread(target=...)``,
+  every ``<executor>.submit(fn, ...)`` on a ``ThreadPoolExecutor``-typed
+  name or attribute, every handler registered via ``signal.signal``
+  (including handlers returned by a local factory), plus ``atexit``
+  hooks and selectors loops for completeness;
+- per-method ``self.<attr>`` read/write sets annotated with the lock
+  attributes held (``with self._lock:``) at each access;
+- the Event-barrier participation set: functions that call ``X.wait()``
+  (zero/one arg) or ``X.set()`` (zero args — the ``threading.Event``
+  signatures), and everything they call, are treated as ordered by an
+  explicit happens-before protocol rather than by luck.
+
+Everything here is per-module and syntactic: a call through a value of
+another class, a global, or ``getattr`` is simply not an edge.  The
+rules built on top are tuned so that imprecision yields silence, not
+noise.  Pure ``ast``; must stay importable without jax (the lint gate
+runs backend-free).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["AttrAccess", "FunctionInfo", "ThreadRoot", "ModuleGraph",
+           "module_graph", "MAIN_ROOT"]
+
+MAIN_ROOT = "<main>"
+
+# threading/queue factories whose product is itself a synchronization
+# object — attributes holding one are never FTP011 "shared state" (an
+# Event/Lock/Queue is safe to touch from any thread by design).
+_SYNC_FACTORIES = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "ThreadPoolExecutor", "ProcessPoolExecutor", "local",
+}
+# The subset that counts as a *lock* for `with self._x:` guard tracking.
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# Container methods that mutate their receiver: `self.xs.append(...)`
+# is a WRITE to attribute `xs`.  Deliberately excludes generic verbs
+# (`write`, `read`, `put`, `send`) that name I/O APIs of owned objects
+# rather than container mutation.
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "remove", "clear", "update", "add",
+    "discard", "pop", "popitem", "setdefault", "appendleft", "popleft",
+    "sort", "reverse",
+}
+
+
+def _attr_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """``a.b.c`` -> ("a", "b", "c"); None when the chain bottoms out in
+    anything but a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class AttrAccess:
+    """One ``self.<attr>`` touch inside a method."""
+
+    attr: str
+    kind: str                  # "read" | "write"
+    line: int
+    col: int
+    locks: frozenset          # lock attr names held at the access
+    func: str                 # qualname of the enclosing function
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str
+    name: str
+    cls: Optional[str]         # enclosing class name (methods) or None
+    line: int
+    node: ast.AST
+    calls: Set[str] = dataclasses.field(default_factory=set)
+    accesses: List[AttrAccess] = dataclasses.field(default_factory=list)
+    barrier: bool = False      # calls X.wait()/X.set() (Event signatures)
+    # root entry qualname -> line where this function started/submitted it
+    starts: Dict[str, int] = dataclasses.field(default_factory=dict)
+    returns_nested: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadRoot:
+    kind: str                  # thread | executor | signal | atexit | selectors
+    entry: str                 # qualname of the entry function ("" unresolved)
+    line: int
+    via: str                   # qualname of the registering function
+
+
+class ModuleGraph:
+    """Call graph, thread roots, and attribute access sets of one module."""
+
+    def __init__(self, tree: ast.AST, path: str = "<module>"):
+        self.path = path
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.sync_attrs: Dict[str, Set[str]] = {}   # class -> sync attr names
+        self.lock_attrs: Dict[str, Set[str]] = {}   # class -> lock attr names
+        self.roots: List[ThreadRoot] = []
+        self._executor_names: Set[str] = set()      # "Cls.attr" or "func.var"
+        self._collect(tree)
+
+    # ------------------------------------------------------------ building
+
+    def _collect(self, tree: ast.AST) -> None:
+        # Pass 1: function table + sync/executor attribute inventory, so
+        # pass 2 resolves forward references.
+        self._walk_defs(tree, prefix=(), cls=None, register_only=True)
+        # Pass 1.5: factory returns (`return _handler`) — needed before
+        # pass 2 so a `signal.signal(sig, self._make_handler(m))` call
+        # that LEXICALLY precedes the factory still resolves.
+        for f in self.functions.values():
+            for node in ast.iter_child_nodes(f.node):
+                self._note_returns(node, f)
+        # Pass 2: bodies (calls, accesses, roots).
+        self._walk_defs(tree, prefix=(), cls=None, register_only=False)
+
+    def _note_returns(self, node: ast.AST, f: FunctionInfo) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+            nested = f"{f.qualname}.{node.value.id}"
+            if nested in self.functions:
+                f.returns_nested.add(nested)
+        for child in ast.iter_child_nodes(node):
+            self._note_returns(child, f)
+
+    def _walk_defs(self, node: ast.AST, prefix: Tuple[str, ...],
+                   cls: Optional[str], register_only: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._walk_defs(child, prefix + (child.name,), child.name,
+                                register_only)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(prefix + (child.name,))
+                if register_only:
+                    self.functions[qual] = FunctionInfo(
+                        qualname=qual, name=child.name, cls=cls,
+                        line=child.lineno, node=child)
+                    self._scan_sync_attrs(child, cls, qual)
+                else:
+                    self._scan_body(self.functions[qual])
+                # Nested defs belong to the function scope, not the class.
+                self._walk_defs(child, prefix + (child.name,), None,
+                                register_only)
+
+    def _scan_sync_attrs(self, fn: ast.AST, cls: Optional[str],
+                         qual: str) -> None:
+        """Record sync-object and executor-typed attributes/locals."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            values = [node.value]
+            if isinstance(node.value, ast.IfExp):   # TPE(...) if x else None
+                values = [node.value.body, node.value.orelse]
+            kinds = set()
+            for v in values:
+                if isinstance(v, ast.Call):
+                    chain = _attr_chain(v.func)
+                    if chain and chain[-1] in _SYNC_FACTORIES:
+                        kinds.add(chain[-1])
+            if not kinds:
+                continue
+            for tgt in node.targets:
+                chain = _attr_chain(tgt)
+                if (cls and chain and len(chain) == 2
+                        and chain[0] in ("self", "cls")):
+                    self.sync_attrs.setdefault(cls, set()).add(chain[1])
+                    if kinds & _LOCK_FACTORIES:
+                        self.lock_attrs.setdefault(cls, set()).add(chain[1])
+                    if "ThreadPoolExecutor" in kinds or \
+                            "ProcessPoolExecutor" in kinds:
+                        self._executor_names.add(f"{cls}.{chain[1]}")
+                elif isinstance(tgt, ast.Name):
+                    if "ThreadPoolExecutor" in kinds or \
+                            "ProcessPoolExecutor" in kinds:
+                        self._executor_names.add(f"{qual}.{tgt.id}")
+
+    # ------------------------------------------------- name resolution
+
+    def _resolve(self, node: ast.AST, info: FunctionInfo) -> Optional[str]:
+        """Resolve a callable reference to an in-module qualname."""
+        if isinstance(node, ast.Name):
+            nested = f"{info.qualname}.{node.id}"
+            if nested in self.functions:
+                return nested
+            if info.cls:
+                # unqualified method refs don't exist in Python; fall
+                # through to module scope only.
+                pass
+            if node.id in self.functions:
+                return node.id
+            return None
+        chain = _attr_chain(node)
+        if chain and len(chain) == 2 and chain[0] in ("self", "cls") \
+                and info.cls:
+            cand = f"{info.cls}.{chain[1]}"
+            if cand in self.functions:
+                return cand
+        if chain and ".".join(chain) in self.functions:
+            return ".".join(chain)
+        return None
+
+    # ------------------------------------------------------- body scan
+
+    def _scan_body(self, info: FunctionInfo) -> None:
+        locks = self.lock_attrs.get(info.cls or "", set())
+        self._visit_stmts(list(ast.iter_child_nodes(info.node)), info,
+                          held=frozenset(), locks=locks)
+        # Thread-variable starts: `t = Thread(...)` ... `t.start()` —
+        # the .start() line is the happens-before boundary, not the
+        # constructor line.
+        self._fix_start_lines(info)
+
+    def _visit_stmts(self, nodes, info: FunctionInfo, held: frozenset,
+                     locks: Set[str]) -> None:
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue                    # separate FunctionInfo
+            if isinstance(node, ast.ClassDef):
+                continue
+            if isinstance(node, ast.With):
+                acquired = set()
+                for item in node.items:
+                    chain = _attr_chain(item.context_expr)
+                    if (chain and len(chain) == 2 and chain[0] == "self"
+                            and chain[1] in locks):
+                        acquired.add(chain[1])
+                    # record the lock read itself
+                    self._visit_expr(item.context_expr, info, held)
+                self._visit_stmts(node.body, info,
+                                  held | frozenset(acquired), locks)
+                continue
+            # generic: visit expressions (store/load is read off each
+            # node's ctx, set by the parser), recurse into nested stmts
+            for _field, value in ast.iter_fields(node):
+                if isinstance(value, list):
+                    stmts = [v for v in value if isinstance(v, ast.stmt)]
+                    if stmts:
+                        self._visit_stmts(stmts, info, held, locks)
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            self._visit_expr(v, info, held)
+                        elif isinstance(v, ast.excepthandler):
+                            self._visit_stmts(v.body, info, held, locks)
+                elif isinstance(value, ast.expr):
+                    self._visit_expr(value, info, held)
+
+    def _visit_expr(self, node: ast.AST, info: FunctionInfo,
+                    held: frozenset) -> None:
+        if node is None:
+            return
+        todo = [node]
+        while todo:
+            sub = todo.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue                  # separate scope: pruned
+            todo.extend(ast.iter_child_nodes(sub))
+            if isinstance(sub, ast.Attribute):
+                chain = _attr_chain(sub)
+                if chain and len(chain) == 2 and chain[0] == "self":
+                    kind = ("write" if isinstance(sub.ctx,
+                                                  (ast.Store, ast.Del))
+                            else "read")
+                    info.accesses.append(AttrAccess(
+                        attr=chain[1], kind=kind, line=sub.lineno,
+                        col=sub.col_offset, locks=held,
+                        func=info.qualname))
+            elif isinstance(sub, ast.Subscript):
+                # self.x[k] = v  — mutation of attribute x
+                if isinstance(sub.ctx, (ast.Store, ast.Del)):
+                    chain = _attr_chain(sub.value)
+                    if chain and len(chain) == 2 and chain[0] == "self":
+                        info.accesses.append(AttrAccess(
+                            attr=chain[1], kind="write", line=sub.lineno,
+                            col=sub.col_offset, locks=held,
+                            func=info.qualname))
+            elif isinstance(sub, ast.Call):
+                self._visit_call(sub, info, held)
+
+    def _visit_call(self, call: ast.Call, info: FunctionInfo,
+                    held: frozenset) -> None:
+        chain = _attr_chain(call.func)
+        # self.xs.append(...) — container mutation of attribute xs
+        if (chain and len(chain) == 3 and chain[0] == "self"
+                and chain[2] in _MUTATING_METHODS):
+            info.accesses.append(AttrAccess(
+                attr=chain[1], kind="write", line=call.lineno,
+                col=call.col_offset, locks=held, func=info.qualname))
+        # Event-protocol participation: X.wait() / X.wait(t) / X.set()
+        if isinstance(call.func, ast.Attribute):
+            m = call.func.attr
+            if (m == "wait" and len(call.args) <= 1) or \
+                    (m == "set" and not call.args and not call.keywords):
+                info.barrier = True
+        # call edges
+        target = self._resolve(call.func, info)
+        if target:
+            info.calls.add(target)
+        # thread roots
+        self._scan_root(call, chain, info)
+
+    def _scan_root(self, call: ast.Call, chain, info: FunctionInfo) -> None:
+        if chain and chain[-1] == "Thread" and \
+                chain[0] in ("threading", "Thread"):
+            target = None
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            entry = self._resolve(target, info) if target is not None else None
+            self.roots.append(ThreadRoot("thread", entry or "",
+                                         call.lineno, info.qualname))
+            if entry:
+                info.starts[entry] = call.lineno
+            return
+        if chain and len(chain) >= 2 and chain[-1] == "submit":
+            recv = chain[:-1]
+            names = set()
+            if len(recv) == 2 and recv[0] == "self" and info.cls:
+                names.add(f"{info.cls}.{recv[1]}")
+            elif len(recv) == 1:
+                names.add(f"{info.qualname}.{recv[0]}")
+            if names & self._executor_names and call.args:
+                entry = self._resolve(call.args[0], info)
+                self.roots.append(ThreadRoot("executor", entry or "",
+                                             call.lineno, info.qualname))
+                if entry:
+                    info.starts[entry] = call.lineno
+            return
+        if chain and chain[-1] == "signal" and len(chain) == 2 \
+                and len(call.args) >= 2:
+            handler = call.args[1]
+            entries: List[str] = []
+            resolved = self._resolve(handler, info)
+            if resolved:
+                entries.append(resolved)
+            elif isinstance(handler, ast.Call):
+                factory = self._resolve(handler.func, info)
+                if factory and factory in self.functions:
+                    entries.extend(
+                        sorted(self.functions[factory].returns_nested))
+            for e in entries or [""]:
+                self.roots.append(ThreadRoot("signal", e, call.lineno,
+                                             info.qualname))
+            return
+        if chain == ("atexit", "register") and call.args:
+            entry = self._resolve(call.args[0], info)
+            self.roots.append(ThreadRoot("atexit", entry or "",
+                                         call.lineno, info.qualname))
+            return
+        if chain and chain[-1] == "DefaultSelector" and \
+                chain[0] == "selectors":
+            self.roots.append(ThreadRoot("selectors", info.qualname,
+                                         call.lineno, info.qualname))
+
+    def _fix_start_lines(self, info: FunctionInfo) -> None:
+        """If `t = Thread(target=...)` is followed by `t.start()`, move
+        the happens-before boundary to the .start() line."""
+        assigns: Dict[str, str] = {}    # var name -> entry qualname
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                chain = _attr_chain(node.value.func)
+                if chain and chain[-1] == "Thread":
+                    entry = None
+                    for kw in node.value.keywords:
+                        if kw.arg == "target":
+                            entry = self._resolve(kw.value, info)
+                    if entry and len(node.targets) == 1 and \
+                            isinstance(node.targets[0], ast.Name):
+                        assigns[node.targets[0].id] = entry
+            elif isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain and len(chain) == 2 and chain[1] == "start" \
+                        and chain[0] in assigns:
+                    entry = assigns[chain[0]]
+                    if entry in info.starts:
+                        info.starts[entry] = max(info.starts[entry],
+                                                 node.lineno)
+
+    # ------------------------------------------------------------ queries
+
+    def reachable_from(self, entry: str) -> Set[str]:
+        seen: Set[str] = set()
+        todo = [entry]
+        while todo:
+            q = todo.pop()
+            if q in seen or q not in self.functions:
+                continue
+            seen.add(q)
+            todo.extend(self.functions[q].calls)
+        return seen
+
+    def thread_entries(self) -> List[ThreadRoot]:
+        return [r for r in self.roots
+                if r.kind in ("thread", "executor") and r.entry]
+
+    def signal_entries(self) -> List[ThreadRoot]:
+        return [r for r in self.roots if r.kind == "signal" and r.entry]
+
+    def roots_for(self) -> Dict[str, Set[str]]:
+        """function qualname -> set of roots it may run under.
+
+        Thread/executor entries contribute their entry qualname; signal
+        handlers run ON the main thread (between bytecodes) so they do
+        not create a concurrency root.  ``MAIN_ROOT`` is assigned by
+        fixpoint from the functions nobody in-module calls and that are
+        not thread entries themselves (the public API the main thread
+        drives), then propagated down call edges.
+        """
+        rootmap: Dict[str, Set[str]] = {q: set() for q in self.functions}
+        entries = {r.entry for r in self.thread_entries()}
+        sig = {r.entry for r in self.signal_entries()}
+        for e in entries:
+            for q in self.reachable_from(e):
+                rootmap[q].add(e)
+        called: Set[str] = set()
+        for f in self.functions.values():
+            called |= f.calls
+        main_seeds = [q for q in self.functions
+                      if q not in called and q not in entries and q not in sig]
+        main_reach: Set[str] = set()
+        todo = list(main_seeds)
+        while todo:
+            q = todo.pop()
+            if q in main_reach or q not in self.functions:
+                continue
+            if q in entries or q in sig:
+                continue            # entering a root's entry switches root
+            main_reach.add(q)
+            todo.extend(self.functions[q].calls)
+        for q in main_reach:
+            rootmap[q].add(MAIN_ROOT)
+        return rootmap
+
+    def barrier_covered(self) -> Set[str]:
+        """Functions ordered by an explicit Event protocol: every
+        function that waits/sets, plus everything those call (a callee
+        of a barrier-ordered frame inherits its ordering)."""
+        seeds = [q for q, f in self.functions.items() if f.barrier]
+        seen: Set[str] = set()
+        todo = list(seeds)
+        while todo:
+            q = todo.pop()
+            if q in seen or q not in self.functions:
+                continue
+            seen.add(q)
+            todo.extend(self.functions[q].calls)
+        return seen
+
+
+def module_graph(tree: ast.AST, path: str) -> ModuleGraph:
+    """Build (or fetch the cached) ModuleGraph for one parsed module.
+
+    Cached on the tree object itself: the three interprocedural rules
+    run back-to-back over the same tree and must not triple the walk.
+    """
+    g = getattr(tree, "_fedtpu_module_graph", None)
+    if g is None or g.path != path:
+        g = ModuleGraph(tree, path)
+        try:
+            tree._fedtpu_module_graph = g   # type: ignore[attr-defined]
+        except (AttributeError, TypeError):
+            pass
+    return g

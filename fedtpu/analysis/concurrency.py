@@ -1,0 +1,254 @@
+"""Interprocedural concurrency rules: FTP011 (cross-thread shared state)
+and FTP012 (non-reentrant signal handlers).
+
+Both rules flow per-function facts over the module call graph
+(:mod:`fedtpu.analysis.callgraph`) instead of looking at one statement
+at a time:
+
+- **FTP011** computes, for every ``threading.Thread`` target and every
+  ``ThreadPoolExecutor.submit`` target, the set of ``self.<attr>``
+  reads/writes reachable from that root, and flags a mutable attribute
+  written under one root and read or written under another when no
+  common ``with self._lock:`` guards both sides and neither side
+  participates in an Event happens-before protocol (``X.wait()`` /
+  ``X.set()``).  The cohort scheduler's ``_wb_done`` prefetch/writeback
+  discipline and the netproxy's ``_lock``-guarded counters are the
+  pinned negatives; an unguarded container touched from both sides of a
+  thread boundary is the positive.
+- **FTP012** walks every handler registered through ``signal.signal``
+  (including closures returned by a local factory) plus everything the
+  handler calls, and flags operations off a small async-signal-safe
+  allowlist: lock acquisition (a CPython handler runs ON the main
+  thread between bytecodes, so taking a lock a main-thread frame
+  already holds is a self-deadlock), I/O, allocation-heavy calls.  A
+  handler that only stores a flag — the supervisor's SIGTERM/SIGUSR
+  forwarding pattern — is clean.
+
+Heuristics are deliberately one-sided: an unresolvable call or an
+attribute reached through another object yields silence, not noise.
+Per-line ``# fedtpu: noqa[FTP011]``-style suppressions with a
+justification work exactly as for FTP001–FTP010.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from fedtpu.analysis.callgraph import (MAIN_ROOT, AttrAccess, ModuleGraph,
+                                       _attr_chain, module_graph)
+from fedtpu.analysis.engine import Finding, rule
+
+__all__ = ["check_cross_thread_state", "check_signal_handler_safety"]
+
+
+def _root_label(root: str) -> str:
+    return "the main thread" if root == MAIN_ROOT else f"thread root '{root}'"
+
+
+def _conflicts(g: ModuleGraph) -> Iterable[Tuple[AttrAccess, str,
+                                                 AttrAccess, str]]:
+    """Yield (write, write_root, other, other_root) conflicting pairs."""
+    rootmap = g.roots_for()
+    barrier = g.barrier_covered()
+    start_sites = {}        # root entry -> (starter func, line)
+    for f in g.functions.values():
+        for entry, line in f.starts.items():
+            start_sites[entry] = (f.qualname, line)
+
+    per_attr: Dict[Tuple[str, str], List[AttrAccess]] = {}
+    for f in g.functions.values():
+        if f.name == "__init__":
+            continue        # construction happens-before thread start
+        if not f.cls:
+            continue
+        for a in f.accesses:
+            if a.attr in g.sync_attrs.get(f.cls, set()):
+                continue    # Lock/Event/Queue/executor: safe by design
+            per_attr.setdefault((f.cls, a.attr), []).append(a)
+
+    for (_cls, _attr), accesses in sorted(per_attr.items()):
+        writes = [a for a in accesses if a.kind == "write"]
+        if not writes:
+            continue
+        emitted = False
+        for w in writes:
+            if emitted:
+                break
+            w_roots = rootmap.get(w.func, set())
+            for a in accesses:
+                a_roots = rootmap.get(a.func, set())
+                pair = _pick_disjoint(w, w_roots, a, a_roots, start_sites)
+                if pair is None:
+                    continue
+                r1, r2 = pair
+                if w.locks & a.locks:
+                    continue        # same lock guards both sides
+                if w.func in barrier and a.func in barrier:
+                    continue        # explicit happens-before protocol
+                yield w, r1, a, r2
+                emitted = True
+                break
+
+
+def _pick_disjoint(w: AttrAccess, w_roots, a: AttrAccess, a_roots,
+                   start_sites):
+    """A (r1, r2) root pair proving the two accesses can run
+    concurrently, or None.  Accesses in the function that STARTS a root,
+    lexically before the start/submit call, happen-before that root and
+    cannot race with it."""
+    for r1 in sorted(w_roots):
+        for r2 in sorted(a_roots):
+            if r1 == r2:
+                continue
+            if _prestart(w, r2, start_sites) or _prestart(a, r1, start_sites):
+                continue
+            if w is a and w.kind != "write":
+                continue
+            return r1, r2
+    return None
+
+
+def _prestart(acc: AttrAccess, other_root: str, start_sites) -> bool:
+    site = start_sites.get(other_root)
+    return (site is not None and acc.func == site[0]
+            and acc.line <= site[1])
+
+
+@rule(
+    "FTP011",
+    "cross-thread-shared-state",
+    "mutable attribute written under one thread root and read/written "
+    "under another with no common lock or Event barrier on the path — "
+    "a data race against the golden artifacts' bitwise determinism",
+)
+def check_cross_thread_state(tree: ast.AST, src: str, path: str):
+    g = module_graph(tree, path)
+    if not g.thread_entries():
+        return
+    for w, r1, a, r2 in _conflicts(g):
+        other = (f"written at line {a.line}" if a.kind == "write"
+                 else f"read at line {a.line}")
+        yield Finding(
+            rule="FTP011", path=path, line=w.line, col=w.col,
+            message=(
+                f"attribute '{w.attr}' written under {_root_label(r1)} "
+                f"and {other} under {_root_label(r2)} with no common "
+                f"'with lock:' or Event barrier — guard both sides with "
+                f"one lock, or order them with a threading.Event"),
+        )
+
+
+# --------------------------------------------------------------- FTP012
+
+# Call targets a CPython signal handler may safely reach: cheap pure
+# builtins plus the handful of syscalls the async-signal-safe contract
+# blesses.  Everything else — allocation-heavy I/O, lock acquisition,
+# anything that can re-enter interpreter machinery holding state — is
+# flagged.
+_SIG_SAFE_BUILTINS = {
+    "int", "float", "str", "bool", "len", "min", "max", "abs", "id",
+    "getattr", "setattr", "isinstance", "round",
+}
+_SIG_SAFE_CHAINS = {
+    ("os", "write"), ("os", "kill"), ("os", "getpid"),
+}
+_SIG_SAFE_MODULES = {"signal", "_signal"}
+
+
+def _handler_functions(g: ModuleGraph) -> Dict[str, str]:
+    """qualname -> entry handler it is reachable from."""
+    out: Dict[str, str] = {}
+    for r in g.signal_entries():
+        for q in sorted(g.reachable_from(r.entry)):
+            out.setdefault(q, r.entry)
+    return out
+
+
+@rule(
+    "FTP012",
+    "signal-handler-unsafe",
+    "signal handler (or a function it calls) performs a non-reentrant "
+    "operation — lock acquisition, I/O, or other allocation-heavy work "
+    "off the async-signal-safe allowlist; a handler runs on the main "
+    "thread between bytecodes and can deadlock against the very frame "
+    "it interrupted — store a flag and act on it from the loop instead",
+)
+def check_signal_handler_safety(tree: ast.AST, src: str, path: str):
+    g = module_graph(tree, path)
+    handlers = _handler_functions(g)
+    for qual, entry in sorted(handlers.items()):
+        info = g.functions[qual]
+        lock_attrs = g.lock_attrs.get(info.cls or "", set())
+        where = ("" if qual == entry
+                 else f" (reached from handler '{entry}')")
+        yield from _scan_handler(info.node, g, info, lock_attrs, path, where)
+
+
+def _scan_handler(fn: ast.AST, g: ModuleGraph, info, lock_attrs,
+                  path: str, where: str):
+    todo = list(ast.iter_child_nodes(fn))
+    while todo:
+        node = todo.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        todo.extend(ast.iter_child_nodes(node))
+        if isinstance(node, ast.withitem):
+            chain = _attr_chain(node.context_expr)
+            if chain and ((len(chain) == 2 and chain[0] == "self"
+                           and chain[1] in lock_attrs)
+                          or "lock" in chain[-1].lower()):
+                yield Finding(
+                    rule="FTP012", path=path,
+                    line=node.context_expr.lineno,
+                    col=node.context_expr.col_offset,
+                    message=(
+                        f"signal handler{where} acquires lock "
+                        f"'{'.'.join(chain)}' — handlers run on the main "
+                        f"thread between bytecodes, so this deadlocks "
+                        f"when the interrupted frame already holds it"),
+                )
+        elif isinstance(node, ast.Call):
+            yield from _check_handler_call(node, g, info, path, where)
+
+
+def _check_handler_call(call: ast.Call, g: ModuleGraph, info, path, where):
+    chain = _attr_chain(call.func)
+    if isinstance(call.func, ast.Name):
+        name = call.func.id
+        if name in _SIG_SAFE_BUILTINS:
+            return
+        if g._resolve(call.func, info):
+            return                      # local call: its body is scanned
+        yield Finding(
+            rule="FTP012", path=path, line=call.lineno, col=call.col_offset,
+            message=(f"signal handler{where} calls '{name}()' which is "
+                     f"not async-signal-safe"),
+        )
+        return
+    if chain is None:
+        return                          # dynamic target: stay silent
+    if chain[0] in _SIG_SAFE_MODULES or chain in _SIG_SAFE_CHAINS:
+        return
+    if g._resolve(call.func, info):
+        return                          # self.method: body scanned
+    tail = chain[-1]
+    if tail in ("acquire",):
+        msg = (f"signal handler{where} acquires lock via "
+               f"'{'.'.join(chain)}()' — self-deadlock against the "
+               f"interrupted main-thread frame")
+    elif chain[0] in ("json", "pickle", "logging", "subprocess") or \
+            tail in ("open", "print", "sleep", "join", "flush", "dump",
+                     "dumps", "makedirs", "replace", "unlink", "sendall",
+                     "connect", "recv", "send"):
+        msg = (f"signal handler{where} performs non-reentrant I/O "
+               f"'{'.'.join(chain)}()' — store a flag and do the work "
+               f"from the loop")
+    else:
+        # Attribute store/load helpers (dict.get, Event.is_set, simple
+        # accessors) are tolerated: flagging every method call would
+        # drown the true positives.
+        return
+    yield Finding(rule="FTP012", path=path, line=call.lineno,
+                  col=call.col_offset, message=msg)

@@ -1,0 +1,253 @@
+"""FTP013 — nondeterminism taint into canonical-artifact sinks.
+
+Every golden in this repo (autoscale decisions, netlogs, defense/net/
+timeline sims, lockdep graphs) is compared *bitwise*, and the writers
+all funnel through ``json.dumps``.  Two distinct failure modes break
+that contract:
+
+1. a **nondeterministic value** — wall clock outside ``utils/timing.py``
+   (``time.time``/``perf_counter``/``monotonic`` and ``_ns`` variants),
+   ``uuid``, ``os.urandom``/``secrets``, module-level unseeded
+   ``random`` — flowing into a dump that *claims* canonical form
+   (``sort_keys=True``): the keys are sorted but the bytes still differ
+   run to run;
+2. a **nondeterministic ordering** — a ``set`` (or anything built from
+   one) serialized by a dump *without* ``sort_keys=True``: the values
+   are stable but the byte order is not.  A dump that opts into compact
+   ``separators=(",", ":")`` is declaring canonical intent, so omitting
+   ``sort_keys`` there is flagged even without visible set taint.
+
+Taint is tracked per function, locally and syntactically: assignments,
+tuple unpacking, f-strings, arithmetic, container displays, loop
+targets and call arguments propagate; ``sorted()`` launders ordering
+taint; ``len()`` launders everything.  Imprecision is one-sided — an
+untracked flow stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Set
+
+from fedtpu.analysis.callgraph import _attr_chain
+from fedtpu.analysis.engine import Finding, rule
+
+__all__ = ["check_nondeterminism_taint"]
+
+# Taint kinds.
+WALL = "wall-clock"
+UUID = "uuid"
+RAND = "entropy"
+SETORD = "set-ordering"
+_VALUE_KINDS = (WALL, UUID, RAND)
+
+_WALL_CALLS = {
+    ("time", "time"), ("time", "time_ns"),
+    ("time", "perf_counter"), ("time", "perf_counter_ns"),
+    ("time", "monotonic"), ("time", "monotonic_ns"),
+}
+_RAND_CHAINS = {("os", "urandom")}
+_RAND_MODULES = {"secrets"}
+# Module-level random.* draws are unseeded process-global state; an
+# instance ``rng.random()`` went through a seeded ``random.Random(seed)``
+# and is deterministic, so only the bare module calls taint.
+_RANDOM_FUNCS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "getrandbits", "gauss", "normalvariate",
+}
+
+
+def _call_taint(call: ast.Call, taint: Dict[str, Set[str]],
+                in_timing_module: bool) -> Set[str]:
+    """Taint kinds produced by a call expression."""
+    chain = _attr_chain(call.func)
+    out: Set[str] = set()
+    if chain:
+        if chain in _WALL_CALLS and not in_timing_module:
+            out.add(WALL)
+        if chain[0] == "uuid":
+            out.add(UUID)
+        if chain in _RAND_CHAINS or chain[0] in _RAND_MODULES:
+            out.add(RAND)
+        if chain[0] == "random" and len(chain) == 2 \
+                and chain[1] in _RANDOM_FUNCS:
+            out.add(RAND)
+    name = call.func.id if isinstance(call.func, ast.Name) else None
+    if name in ("set", "frozenset"):
+        out.add(SETORD)
+    # Launderers: sorted() fixes ordering; len()/id-free scalars fix all.
+    arg_taint: Set[str] = set()
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        arg_taint |= _expr_taint(a, taint, in_timing_module)
+    if name == "sorted":
+        arg_taint.discard(SETORD)
+    if name in ("len", "bool", "type"):
+        arg_taint = set()
+    return out | arg_taint
+
+
+def _expr_taint(node: Optional[ast.AST], taint: Dict[str, Set[str]],
+                in_timing: bool) -> Set[str]:
+    if node is None:
+        return set()
+    if isinstance(node, ast.Name):
+        return set(taint.get(node.id, ()))
+    if isinstance(node, ast.Call):
+        return _call_taint(node, taint, in_timing)
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        inner: Set[str] = {SETORD}
+        for child in ast.iter_child_nodes(node):
+            inner |= _expr_taint(child, taint, in_timing)
+        return inner
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return set()
+    out: Set[str] = set()
+    for child in ast.iter_child_nodes(node):
+        out |= _expr_taint(child, taint, in_timing)
+    return out
+
+
+class _FunctionTaint(ast.NodeVisitor):
+    """One pass over a function body: propagate taint through local
+    assignments in statement order, check each json.dumps/json.dump."""
+
+    def __init__(self, path: str, in_timing: bool):
+        self.path = path
+        self.in_timing = in_timing
+        self.taint: Dict[str, Set[str]] = {}
+        self.findings: list = []
+
+    # --- assignment forms -------------------------------------------------
+    def visit_Assign(self, node: ast.Assign):
+        kinds = _expr_taint(node.value, self.taint, self.in_timing)
+        for tgt in node.targets:
+            self._bind(tgt, kinds, node.value)
+        self._check_calls(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            kinds = _expr_taint(node.value, self.taint, self.in_timing)
+            self._bind(node.target, kinds, node.value)
+            self._check_calls(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        kinds = _expr_taint(node.value, self.taint, self.in_timing)
+        if isinstance(node.target, ast.Name):
+            self.taint.setdefault(node.target.id, set()).update(kinds)
+        self._check_calls(node.value)
+
+    def visit_For(self, node: ast.For):
+        kinds = _expr_taint(node.iter, self.taint, self.in_timing)
+        self._bind(node.target, kinds, node.iter)
+        self._check_calls(node.iter)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def _bind(self, tgt: ast.AST, kinds: Set[str], value: ast.AST):
+        if isinstance(tgt, ast.Name):
+            self.taint[tgt.id] = set(kinds)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._bind(el, kinds, value)
+        elif isinstance(tgt, ast.Subscript):
+            # d[k] = tainted — the container becomes tainted too.
+            base = tgt.value
+            if isinstance(base, ast.Name) and kinds:
+                self.taint.setdefault(base.id, set()).update(kinds)
+
+    # --- nested scopes: separate taint universes --------------------------
+    def visit_FunctionDef(self, node):          # noqa: D102
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def generic_visit(self, node):
+        self._check_calls(node, recurse=False)
+        super().generic_visit(node)
+
+    # --- the sink ---------------------------------------------------------
+    def _check_calls(self, node: ast.AST, recurse: bool = True):
+        nodes: Iterable[ast.AST]
+        if recurse:
+            nodes = ast.walk(node)
+        else:
+            nodes = [node] if isinstance(node, ast.Call) else []
+        for sub in nodes:
+            if isinstance(sub, ast.Call):
+                self._check_dump(sub)
+
+    def _check_dump(self, call: ast.Call):
+        chain = _attr_chain(call.func)
+        if chain not in (("json", "dumps"), ("json", "dump")):
+            return
+        sort_keys = False
+        compact = False
+        for kw in call.keywords:
+            if kw.arg == "sort_keys" and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is True:
+                sort_keys = True
+            if kw.arg == "separators":
+                compact = True
+        payload = call.args[0] if call.args else None
+        kinds = _expr_taint(payload, self.taint, self.in_timing)
+        value_kinds = sorted(k for k in kinds if k in _VALUE_KINDS)
+        if sort_keys and value_kinds:
+            self.findings.append(Finding(
+                rule="FTP013", path=self.path, line=call.lineno,
+                col=call.col_offset,
+                message=(
+                    f"nondeterministic value ({', '.join(value_kinds)}) "
+                    f"flows into a canonical json dump (sort_keys=True) — "
+                    f"golden artifacts diff bitwise, so the payload must "
+                    f"be derived from seeded/deterministic state only"),
+            ))
+        if not sort_keys and SETORD in kinds:
+            self.findings.append(Finding(
+                rule="FTP013", path=self.path, line=call.lineno,
+                col=call.col_offset,
+                message=(
+                    "set-derived data serialized without sort_keys=True — "
+                    "iteration order is not canonical; add sort_keys=True "
+                    "or sort before dumping"),
+            ))
+        elif not sort_keys and compact:
+            self.findings.append(Finding(
+                rule="FTP013", path=self.path, line=call.lineno,
+                col=call.col_offset,
+                message=(
+                    "compact separators declare canonical intent but "
+                    "sort_keys=True is missing — dict insertion order "
+                    "leaks into the canonical bytes; add sort_keys=True"),
+            ))
+
+
+@rule(
+    "FTP013",
+    "nondeterminism-into-canonical-dump",
+    "nondeterminism source (wall clock outside utils/timing.py, uuid, "
+    "os.urandom/secrets, unseeded random, set iteration order) taints a "
+    "canonical json.dumps sink, or a canonical-intent dump (compact "
+    "separators) omits sort_keys=True — either way the goldened bytes "
+    "are not reproducible",
+)
+def check_nondeterminism_taint(tree: ast.AST, src: str, path: str):
+    in_timing = path.replace("\\", "/").endswith("utils/timing.py")
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            v = _FunctionTaint(path, in_timing)
+            for stmt in node.body:
+                v.visit(stmt)
+            yield from v.findings
+    # Module level too (golden writers are sometimes plain scripts).
+    if isinstance(tree, ast.Module):
+        v = _FunctionTaint(path, in_timing)
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            v.visit(stmt)
+        yield from v.findings

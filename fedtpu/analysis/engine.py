@@ -197,7 +197,8 @@ def lint_paths(
     """Lint every .py file under ``paths`` (files or directories)."""
     # Importing the rule modules registers the checkers; deferred so that
     # engine import alone never drags rule deps in the wrong order.
-    from fedtpu.analysis import rules_generic, rules_jax  # noqa: F401
+    from fedtpu.analysis import (concurrency, determinism,  # noqa: F401
+                                 rules_generic, rules_jax)
 
     total = LintResult()
     for f in iter_python_files(paths):

@@ -1,0 +1,273 @@
+"""Runtime lock-order sanitizer (lockdep) — the dynamic complement to
+the static FTP011/FTP012 pass.
+
+Static analysis proves individual modules use their locks; it cannot
+prove the *fleet-wide acquisition order* is acyclic.  This module can:
+:class:`TrackedLock` is a drop-in ``threading.Lock`` wrapper that
+records, for every acquisition, the set of tracked locks the acquiring
+thread already holds — each (held → acquired) pair is an edge in the
+:class:`LockGraph`.  A cycle in that graph is a potential deadlock
+(thread 1 holds A wants B, thread 2 holds B wants A).
+
+``run_drills()`` exercises the threaded subsystems through short,
+fully scripted scenarios — netproxy record/stats/stop, watchdog
+arm/guard/disarm, the scheduler's prefetch/writeback Event handoff,
+and an overlap-compile submit/get round trip — with their real locks
+swapped for TrackedLocks.  The resulting graph renders to canonical
+JSON (sorted, compact separators) and is compared **bitwise** against
+``tests/goldens/lockdep.json`` by ``fedtpu check --lockdep``: any new
+lock, any new nesting edge, or a dropped drill changes the bytes and
+fails the gate.  The committed golden pins the current discipline —
+every tracked lock is leaf-level (zero nesting edges), which makes the
+fleet deadlock-free by construction.
+
+Drills are deterministic: no polling threads, every cross-thread
+handoff is Event-ordered, and the graph render sorts everything.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["TrackedLock", "LockGraph", "run_drills", "render_graph",
+           "compare_graph", "default_golden_path", "DRILLS"]
+
+LOCKDEP_SCHEMA_VERSION = 1
+
+
+class LockGraph:
+    """Lock-acquisition-order graph: nodes are tracked lock names,
+    an edge (a, b) means some thread acquired b while holding a."""
+
+    def __init__(self) -> None:
+        self.nodes: Set[str] = set()
+        self.edges: Set[Tuple[str, str]] = set()
+        # Per-thread stack of held tracked locks.  Guarded by _meta so
+        # drill threads can record concurrently; _meta is internal
+        # bookkeeping and never nests inside a tracked lock's user code.
+        self._held: Dict[int, List[str]] = {}
+        self._meta = threading.Lock()
+
+    def register(self, name: str) -> None:
+        with self._meta:
+            self.nodes.add(name)
+
+    def note_acquire(self, name: str) -> None:
+        tid = threading.get_ident()
+        with self._meta:
+            stack = self._held.setdefault(tid, [])
+            for held in stack:
+                if held != name:
+                    self.edges.add((held, name))
+            stack.append(name)
+
+    def note_release(self, name: str) -> None:
+        tid = threading.get_ident()
+        with self._meta:
+            stack = self._held.get(tid, [])
+            if name in stack:
+                stack.reverse()
+                stack.remove(name)
+                stack.reverse()
+
+    def cycles(self) -> List[List[str]]:
+        """Every elementary cycle's node set, sorted — non-empty means a
+        potential deadlock ordering was observed."""
+        adj: Dict[str, Set[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, set()).add(b)
+        out: List[List[str]] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+
+        def dfs(node: str, path: List[str], on_path: Set[str]) -> None:
+            for nxt in sorted(adj.get(node, ())):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):]
+                    key = tuple(sorted(cyc))
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(sorted(cyc))
+                    continue
+                dfs(nxt, path + [nxt], on_path | {nxt})
+
+        for start in sorted(adj):
+            dfs(start, [start], {start})
+        return sorted(out)
+
+
+class TrackedLock:
+    """Drop-in ``threading.Lock`` recording acquisition order.
+
+    Duck-types the context-manager and acquire/release surface the
+    subsystems actually use (``with self._lock:``), so a drill installs
+    one by plain attribute replacement."""
+
+    def __init__(self, name: str, graph: LockGraph):
+        self.name = name
+        self.graph = graph
+        self._inner = threading.Lock()
+        graph.register(name)
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        # Record at attempt time: the (held -> wanted) edge exists the
+        # moment the thread blocks, whether or not it ever gets the lock.
+        self.graph.note_acquire(self.name)
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok:
+            self.graph.note_release(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self.graph.note_release(self.name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+# ------------------------------------------------------------------ drills
+
+
+def _drill_netproxy(graph: LockGraph) -> None:
+    """Record/stats/stop path of the relay: counter updates and the
+    thread-list handoff all go through ``netproxy._lock``."""
+    from fedtpu.resilience.netfaults import NetFault, NetFaultPlan
+    from fedtpu.serving.netproxy import NetFaultProxy
+
+    plan = NetFaultPlan.load({"faults": []}, num_gateways=1)
+    proxy = NetFaultProxy(plan=plan, gateway_index=0, backend_port=0,
+                          port_file="")
+    proxy._lock = TrackedLock("netproxy._lock", graph)
+    fault = NetFault(kind="net_reset", gateway=0, frame=1)
+    proxy._record(fault, conn=1, frame=1, nbytes=0)
+    with proxy._lock:
+        proxy.frames += 1
+        proxy.frame_bytes += 42
+    proxy.stats()
+    proxy.stop()
+
+
+def _drill_watchdog(graph: LockGraph) -> None:
+    """Arm/guard/disarm around a (pretend) collective window — the
+    armed-state triple is only ever touched under ``watchdog._lock``."""
+    from fedtpu.resilience.distributed import CollectiveWatchdog
+
+    wd = CollectiveWatchdog(timeout=3600.0, poll=3600.0,
+                            _abort=lambda code: None)
+    wd._lock = TrackedLock("watchdog._lock", graph)
+    with wd.guard("allreduce", round_=1):
+        pass
+    wd.arm("broadcast", round_=2)
+    wd.disarm()
+
+
+def _drill_prefetch_writeback(graph: LockGraph) -> None:
+    """The cohort scheduler's cross-thread discipline, distilled: the
+    prefetch worker blocks on ``wb_done`` until the main thread's
+    writeback commits, then reads.  Lock-free by design — the drill
+    pins that it STAYS lock-free (zero tracked locks, zero edges)."""
+    wb_done = threading.Event()
+    prefetched = threading.Event()
+    state = {"round": 0}
+    out: List[int] = []
+
+    def prefetch() -> None:
+        wb_done.wait(timeout=10.0)
+        out.append(state["round"])      # read strictly after writeback
+        prefetched.set()
+
+    worker = threading.Thread(target=prefetch, daemon=True,
+                              name="lockdep-prefetch")
+    worker.start()
+    state["round"] = 7                  # writeback on the main thread
+    wb_done.set()
+    prefetched.wait(timeout=10.0)
+    worker.join(timeout=10.0)
+    if out != [7]:
+        raise RuntimeError(f"prefetch/writeback drill broke ordering: {out}")
+
+
+def _drill_overlap_compile(graph: LockGraph) -> None:
+    """Submit/get round trip through CompileExecutor: the futures dict
+    is caller-thread-only by contract, so the drill pins zero locks."""
+    from fedtpu.compilation.executor import CompileExecutor
+
+    with CompileExecutor(max_workers=1) as ex:
+        fut = ex.submit("lockdep-drill", lambda: 41 + 1)
+        if ex.get("lockdep-drill", timeout=30.0) != 42 or not fut.done():
+            raise RuntimeError("overlap-compile drill build did not land")
+
+
+DRILLS = [
+    ("netproxy_relay", _drill_netproxy),
+    ("overlap_compile", _drill_overlap_compile),
+    ("prefetch_writeback", _drill_prefetch_writeback),
+    ("watchdog_arm_disarm", _drill_watchdog),
+]
+
+
+def run_drills(graph: Optional[LockGraph] = None,
+               only: Optional[List[str]] = None) -> Tuple[LockGraph,
+                                                          List[str]]:
+    """Run every pinned drill against one shared graph; returns the
+    graph and the drill names that ran (both feed the golden)."""
+    graph = graph if graph is not None else LockGraph()
+    ran: List[str] = []
+    for name, fn in DRILLS:
+        if only is not None and name not in only:
+            continue
+        fn(graph)
+        ran.append(name)
+    return graph, ran
+
+
+# ----------------------------------------------------------------- golden
+
+
+def render_graph(graph: LockGraph, drills: List[str]) -> str:
+    """Canonical bytes: sorted nodes/edges/drills, compact separators,
+    one trailing newline — the exact content of the committed golden."""
+    payload = {
+        "v": LOCKDEP_SCHEMA_VERSION,
+        "drills": sorted(drills),
+        "locks": sorted(graph.nodes),
+        "edges": [list(e) for e in sorted(graph.edges)],
+        "cycles": graph.cycles(),
+    }
+    return json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")) + "\n"
+
+
+def compare_graph(rendered: str, golden_path: str) -> dict:
+    """Bitwise golden comparison, audit-gate style."""
+    try:
+        with open(golden_path, encoding="utf-8") as fh:
+            golden = fh.read()
+    except OSError as e:
+        return {"ok": False, "reason": f"golden unreadable: {e}"}
+    if rendered != golden:
+        return {"ok": False,
+                "reason": (f"lock graph diverges from golden "
+                           f"{golden_path}: got {rendered.strip()[:160]} "
+                           f"want {golden.strip()[:160]}")}
+    return {"ok": True,
+            "reason": f"lock graph matches golden ({len(rendered)} bytes)"}
+
+
+def default_golden_path() -> str:
+    """tests/goldens/lockdep.json resolved from the repo layout."""
+    import os
+
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "tests", "goldens", "lockdep.json")

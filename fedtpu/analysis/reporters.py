@@ -1,6 +1,6 @@
-"""Text and JSON reporters for lint results.
+"""Text, JSON, and SARIF reporters for lint results.
 
-Both reporters return strings; the CLI owns the actual write so this
+All reporters return strings; the CLI owns the actual write so this
 module stays side-effect free (and trivially golden-testable).
 """
 
@@ -54,5 +54,60 @@ def render_json(result: LintResult) -> str:
         "findings": [_finding_dict(f) for f in result.findings + result.parse_errors],
         "suppressed": [_finding_dict(f) for f in result.suppressed],
         "rules": {code: RULES[code].doc for code in sorted(RULES)},
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)
+
+
+# SARIF 2.1.0 — the static-analysis interchange format CI systems ingest
+# as inline review annotations. One run, one driver ("fedtpu-lint"), one
+# rule entry per registered FTP code, one result per finding; suppressed
+# findings are carried with a SARIF suppression record so the annotation
+# layer can distinguish "clean" from "justified".
+SARIF_VERSION = "2.1.0"
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _sarif_result(f: Finding, *, suppressed: bool) -> dict:
+    out = {
+        "ruleId": f.rule,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path.replace("\\", "/")},
+                "region": {"startLine": f.line,
+                           "startColumn": f.col + 1},
+            },
+        }],
+    }
+    if suppressed:
+        out["suppressions"] = [{"kind": "inSource",
+                                "justification": "fedtpu: noqa"}]
+    return out
+
+
+def render_sarif(result: LintResult) -> str:
+    results = [_sarif_result(f, suppressed=False)
+               for f in result.findings + result.parse_errors]
+    results += [_sarif_result(f, suppressed=True)
+                for f in result.suppressed]
+    payload = {
+        "$schema": _SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "fedtpu-lint",
+                "informationUri":
+                    "docs/analysis.md",
+                "rules": [
+                    {"id": code,
+                     "name": RULES[code].name,
+                     "shortDescription": {"text": RULES[code].doc}}
+                    for code in sorted(RULES)
+                ],
+            }},
+            "results": results,
+        }],
     }
     return json.dumps(payload, indent=2, sort_keys=True)

@@ -779,8 +779,10 @@ def build_parser() -> argparse.ArgumentParser:
     lint_p.add_argument("paths", nargs="*", default=["fedtpu"],
                         help="files or directories to lint "
                              "(default: fedtpu)")
-    lint_p.add_argument("--format", choices=["text", "json"], default="text",
-                        help="finding rendering (default text)")
+    lint_p.add_argument("--format", choices=["text", "json", "sarif"],
+                        default="text",
+                        help="finding rendering (default text; sarif "
+                             "emits SARIF 2.1.0 for CI annotations)")
     lint_p.add_argument("--select", default=None, metavar="CODES",
                         help="comma-separated rule codes to run exclusively "
                              "(e.g. FTP005 or FTP001,FTP002)")
@@ -857,6 +859,16 @@ def build_parser() -> argparse.ArgumentParser:
                               "the exit code")
     check_p.add_argument("--gateway-count", type=_positive_int, default=1,
                          help="fleet size for --gateway-probe (default 1)")
+    check_p.add_argument("--lockdep", action="store_true",
+                         help="also run the lock-order sanitizer drills "
+                              "(netproxy relay, overlap-compile, "
+                              "prefetch/writeback, watchdog arm/disarm) "
+                              "and compare the acquisition-order graph "
+                              "bitwise against the committed golden, "
+                              "folded into the exit code")
+    check_p.add_argument("--lockdep-golden", default=None, metavar="GOLDEN",
+                         help="golden lock graph for --lockdep (default: "
+                              "tests/goldens/lockdep.json)")
 
     # IR-level program audit: trace the real engines, extract and verify
     # the collective schedule, prove donation, account comm bytes
@@ -1239,7 +1251,8 @@ def main(argv=None) -> int:
         # Before any backend/preset touch: the linter is pure AST and must
         # work in environments with no jax installed at all.
         from fedtpu.analysis.engine import lint_paths
-        from fedtpu.analysis.reporters import render_json, render_text
+        from fedtpu.analysis.reporters import (render_json, render_sarif,
+                                               render_text)
         select = ([c.strip() for c in args.select.split(",") if c.strip()]
                   if args.select else None)
         ignore = ([c.strip() for c in args.ignore.split(",") if c.strip()]
@@ -1250,6 +1263,8 @@ def main(argv=None) -> int:
             raise SystemExit(f"fedtpu lint: {exc}")
         if args.format == "json":
             print(render_json(result))
+        elif args.format == "sarif":
+            print(render_sarif(result))
         else:
             print(render_text(result,
                               show_suppressed=args.show_suppressed))
@@ -1626,6 +1641,26 @@ def main(argv=None) -> int:
             rows = probe_fleet(args.gateway_probe, args.gateway_count)
             report["gateway_probe"] = rows
             report["ok"] = report["ok"] and all(r["ok"] for r in rows)
+        if args.lockdep:
+            # Fold the lock-order sanitizer into the check: the pinned
+            # drills run with the real locks swapped for TrackedLocks
+            # and the resulting acquisition-order graph must match the
+            # committed golden bitwise — a new lock, a new nesting edge,
+            # or a dropped drill fails the gate like a retrace.
+            from fedtpu.analysis.lockdep import (compare_graph,
+                                                 default_golden_path,
+                                                 render_graph, run_drills)
+            golden = args.lockdep_golden or default_golden_path()
+            graph, ran = run_drills()
+            rendered = render_graph(graph, ran)
+            cmp = compare_graph(rendered, golden)
+            cycles = graph.cycles()
+            ok = cmp["ok"] and not cycles
+            report["lockdep"] = {
+                "ok": ok, "reason": cmp["reason"], "golden": golden,
+                "drills": ran, "locks": sorted(graph.nodes),
+                "edges": len(graph.edges), "cycles": cycles}
+            report["ok"] = report["ok"] and ok
         if args.json:
             print(json.dumps(report))
         else:
@@ -1665,6 +1700,12 @@ def main(argv=None) -> int:
                     state = ("up" if r["ok"]
                              else r.get("error", "unreachable"))
                     print(f"gateway {r['gateway']}: {state}")
+            if "lockdep" in report:
+                ld = report["lockdep"]
+                print(f"lockdep: ok={ld['ok']} ({ld['reason']}) "
+                      f"drills={len(ld['drills'])} "
+                      f"locks={len(ld['locks'])} edges={ld['edges']} "
+                      f"cycles={len(ld['cycles'])}")
             print(f"ok: {report['ok']}")
         return 0 if report["ok"] else 1
 

@@ -538,7 +538,7 @@ class CohortScheduler:
     def restore(self, state, round0: int, store_arrays: dict) -> None:
         from fedtpu.parallel.multihost import safe_put
         shard_c = NamedSharding(self.mesh, P(CLIENTS_AXIS))
-        self._state = {
+        self._state = {  # fedtpu: noqa[FTP011] restore() runs before the first run_chunk(), so no _prepare is in flight yet; _prepare only reads _state via the wb_done Event handoff armed inside run_chunk
             "params": jax.tree.map(
                 lambda l: safe_put(np.asarray(l), shard_c),
                 state["params"]),

@@ -139,8 +139,13 @@ class ReshardController:
         self.active = tuple(range(self.process_count))
         self.parked_victim: Optional[int] = None
         self.steps_log: List[dict] = []      # telemetry: executed plan rows
-        # Signal path (guarded: handlers run between bytecodes).
-        self._sig_lock = threading.Lock()
+        # Signal path. Deliberately LOCK-FREE: the handler runs on the
+        # main thread between bytecodes (CPython contract), and every
+        # other reader/writer of _sig_mode is the main-thread round
+        # loop, so a lock adds no exclusion — but taking one inside the
+        # handler self-deadlocks the moment a signal lands while the
+        # loop holds it (threading.Lock is not reentrant). A plain
+        # attribute store is the async-signal-safe discipline here.
         self._sig_mode: Optional[str] = None
         self._notice_round: Optional[int] = None
 
@@ -160,16 +165,17 @@ class ReshardController:
 
     def _make_handler(self, mode: str):
         def _handler(signum, frame):
-            with self._sig_lock:
-                if self._sig_mode is None:
-                    self._sig_mode = mode
+            # Flag store only: no locks, no I/O, no allocation-heavy
+            # work — anything else here can deadlock or corrupt the
+            # very frame the signal interrupted.
+            if self._sig_mode is None:
+                self._sig_mode = mode
         return _handler
 
     def request_signal(self, mode: str) -> None:
         """Programmatic stand-in for the signal (tests)."""
-        with self._sig_lock:
-            if self._sig_mode is None:
-                self._sig_mode = mode
+        if self._sig_mode is None:
+            self._sig_mode = mode
 
     # ------------------------------------------------------------ polling
 
@@ -196,18 +202,15 @@ class ReshardController:
                               victim=victim, seq=self.seq)
 
     def _poll_signal(self, rnd: int) -> Optional[ReshardRequest]:
-        with self._sig_lock:
-            mode = self._sig_mode
+        mode = self._sig_mode
         if mode is None:
             return None
         if mode == "grow" and self.parked_victim is None \
                 and self.process_count > 1:
-            with self._sig_lock:
-                self._sig_mode = None   # nothing to grow back
+            self._sig_mode = None   # nothing to grow back
             return None
         if self.process_count == 1:
-            with self._sig_lock:
-                self._sig_mode = None
+            self._sig_mode = None
             return ReshardRequest(mode=mode, round=rnd, target_clients=0,
                                   victim=-1, seq=self.seq)
         if self.checkpoint_dir is None:
@@ -237,8 +240,7 @@ class ReshardController:
         if rnd < agreed + 1:
             return None                 # fire at the first provably-visible
         lead = records[min(records)]    # loop-top AFTER the last notice
-        with self._sig_lock:
-            self._sig_mode = None
+        self._sig_mode = None
         self._notice_round = None
         return ReshardRequest(mode=str(lead["mode"]), round=rnd,
                               target_clients=0, victim=int(lead["victim"]),
@@ -421,21 +423,17 @@ class ReshardController:
     @property
     def pending(self) -> bool:
         """A reshard is scheduled or signaled but not yet executed."""
-        with self._sig_lock:
-            sig = self._sig_mode is not None
-        return sig or bool(self._scheduled)
+        return self._sig_mode is not None or bool(self._scheduled)
 
     @property
     def signal_pending(self) -> bool:
         """A SIGNAL notice is pending (plan entries excluded) — the loop
         degrades these to a SIGTERM-style drain when the current config
         cannot live-reshard."""
-        with self._sig_lock:
-            return self._sig_mode is not None
+        return self._sig_mode is not None
 
     def clear_signal(self) -> None:
-        with self._sig_lock:
-            self._sig_mode = None
+        self._sig_mode = None
 
     def committed(self, mode: str, victim: int) -> None:
         """Record a completed reshard: advance the ordinal and the active

@@ -114,13 +114,16 @@ class NetFaultProxy:
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name=f"netproxy-g{self.gateway}")
         t.start()
-        self._threads.append(t)
+        with self._lock:
+            self._threads.append(t)
         return self
 
     def stop(self) -> None:
         self._stop.set()
         _close(self._lsock)
-        for t in self._threads:
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=2.0)
 
     def stats(self) -> dict:
@@ -198,7 +201,8 @@ class NetFaultProxy:
                                  daemon=True,
                                  name=f"netproxy-g{self.gateway}-c{conn}")
             t.start()
-            self._threads.append(t)
+            with self._lock:
+                self._threads.append(t)
 
     def _serve(self, csock: socket.socket, conn: int) -> None:
         csock.settimeout(_CONN_TIMEOUT_S)

@@ -103,7 +103,11 @@ class LineBuffer(bytearray):
 
 
 def send_msg(sock: socket.socket, obj: dict) -> None:
-    sock.sendall(json.dumps(obj, separators=(",", ":")).encode() + b"\n")
+    # sort_keys: frame bytes feed the netlog's deterministic byte
+    # counters (frame_bytes), so the encoding must be canonical — the
+    # same payload dict must always serialize to the same bytes.
+    sock.sendall(json.dumps(obj, sort_keys=True,
+                            separators=(",", ":")).encode() + b"\n")
 
 
 def recv_lines(sock: socket.socket, buf: bytearray) -> Iterator[Optional[bytes]]:

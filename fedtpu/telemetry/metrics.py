@@ -1,12 +1,19 @@
 """Process-local counters / gauges / histograms + the compile-event probe.
 
-A ``MetricsRegistry`` is plain host-side bookkeeping — no locks (the round
-loop is single-threaded per process), no jax at import time. The round
-loop resets the default registry at run start, increments it as the run
-progresses (rounds trained, checkpoint saves/restores, async ticks,
-staleness observations, estimated bytes exchanged), and emits
-``registry.snapshot()`` as a ``counters`` event so ``fedtpu report`` can
-total everything offline.
+A ``MetricsRegistry`` is plain host-side bookkeeping, no jax at import
+time. The round loop resets the default registry at run start,
+increments it as the run progresses (rounds trained, checkpoint
+saves/restores, async ticks, staleness observations, estimated bytes
+exchanged), and emits ``registry.snapshot()`` as a ``counters`` event so
+``fedtpu report`` can total everything offline.
+
+The round loop is single-threaded per process, but the registry is NOT:
+``CompileExecutor``'s worker increments ``background_compiles`` from the
+pool thread, and jax's monitoring dispatch may fire the compile probe
+off the main thread. Every instrument therefore updates under one
+registry-wide lock — ``x += n`` is a read-modify-write that loses
+updates under concurrency, and ``snapshot()`` must not observe a
+half-applied histogram.
 
 ``install_compile_probe`` hooks ``jax.monitoring``'s event-duration stream
 (the channel jax itself reports backend compile times on) into the DEFAULT
@@ -21,6 +28,7 @@ semantics) so the report's Prometheus export is a direct rendering.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Dict, Optional, Sequence
 
 # Upper bounds for the staleness histogram: async staleness is a small
@@ -30,23 +38,27 @@ DEFAULT_STALENESS_BINS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 
 
 class Counter:
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
-    def __init__(self):
+    def __init__(self, lock: Optional[threading.Lock] = None):
         self.value = 0.0
+        self._lock = lock if lock is not None else threading.Lock()
 
     def inc(self, n: float = 1.0) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Gauge:
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
-    def __init__(self):
+    def __init__(self, lock: Optional[threading.Lock] = None):
         self.value = 0.0
+        self._lock = lock if lock is not None else threading.Lock()
 
     def set(self, v: float) -> None:
-        self.value = float(v)
+        with self._lock:
+            self.value = float(v)
 
 
 class Histogram:
@@ -54,25 +66,29 @@ class Histogram:
     running count/sum/min/max. ``bucket_counts[i]`` counts observations
     ``<= bins[i]``; one implicit +Inf bucket equals ``count``."""
 
-    __slots__ = ("bins", "bucket_counts", "count", "sum", "min", "max")
+    __slots__ = ("bins", "bucket_counts", "count", "sum", "min", "max",
+                 "_lock")
 
-    def __init__(self, bins: Sequence[float] = DEFAULT_STALENESS_BINS):
+    def __init__(self, bins: Sequence[float] = DEFAULT_STALENESS_BINS,
+                 lock: Optional[threading.Lock] = None):
         self.bins = tuple(float(b) for b in bins)
         self.bucket_counts = [0] * len(self.bins)
         self.count = 0
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        self._lock = lock if lock is not None else threading.Lock()
 
     def observe(self, v: float) -> None:
         v = float(v)
-        self.count += 1
-        self.sum += v
-        self.min = min(self.min, v)
-        self.max = max(self.max, v)
-        for i, b in enumerate(self.bins):
-            if v <= b:
-                self.bucket_counts[i] += 1
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+            for i, b in enumerate(self.bins):
+                if v <= b:
+                    self.bucket_counts[i] += 1
 
     def observe_many(self, values) -> None:
         for v in values:
@@ -88,39 +104,57 @@ class Histogram:
 
 
 class MetricsRegistry:
+    """One lock for the whole registry, shared into every instrument it
+    creates: instrument updates, name->instrument map growth, snapshot
+    and reset all serialize against each other, so a background-compile
+    ``inc()`` can neither lose an update nor tear a snapshot."""
+
     def __init__(self):
+        self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
 
     def counter(self, name: str) -> Counter:
-        return self._counters.setdefault(name, Counter())
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(lock=self._lock)
+            return c
 
     def gauge(self, name: str) -> Gauge:
-        return self._gauges.setdefault(name, Gauge())
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(lock=self._lock)
+            return g
 
     def histogram(self, name: str,
                   bins: Optional[Sequence[float]] = None) -> Histogram:
-        if name not in self._histograms:
-            self._histograms[name] = Histogram(
-                bins if bins is not None else DEFAULT_STALENESS_BINS)
-        return self._histograms[name]
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = Histogram(
+                    bins if bins is not None else DEFAULT_STALENESS_BINS,
+                    lock=self._lock)
+            return self._histograms[name]
 
     def snapshot(self) -> dict:
         """JSON-ready view — the payload of a ``counters`` event."""
-        return {
-            "counters": {k: c.value for k, c in self._counters.items()},
-            "gauges": {k: g.value for k, g in self._gauges.items()},
-            "histograms": {k: h.to_dict()
-                           for k, h in self._histograms.items()},
-        }
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+                "histograms": {k: h.to_dict()
+                               for k, h in self._histograms.items()},
+            }
 
     def reset(self) -> None:
         """Clear all instruments IN PLACE — the registry object's identity
         survives (the compile probe holds a reference across runs)."""
-        self._counters.clear()
-        self._gauges.clear()
-        self._histograms.clear()
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
 
 
 _DEFAULT = MetricsRegistry()

@@ -186,6 +186,15 @@ QUICK_TESTS = {
     "test_analysis.py::test_rule_fixtures_catch_seeded_violations",
     "test_analysis.py::test_text_reporter_golden",
     "test_lint_gate.py::test_repo_lint_gate_is_clean",
+    # concurrency/determinism auditor (PR 17): the lockdep drills and the
+    # fixed-finding regressions are backend-free and run in milliseconds;
+    # the subprocess exit-code fold stays full-tier.
+    "test_lockdep.py::test_abba_ordering_is_detected_as_a_cycle",
+    "test_lockdep.py::test_drills_match_committed_golden_bitwise",
+    "test_concurrency_fixes.py::"
+    "test_send_msg_bytes_are_canonical_across_insertion_order",
+    "test_concurrency_fixes.py::"
+    "test_reshard_handler_fires_while_main_thread_polls",
     # test_multihost_e2e spawns 2 OS processes (~70 s for the round-kernel
     # worker since the int8/Byzantine sections joined) and stays full-tier
     # only; fedtpu/parallel/multihost.py is covered above in-process.

@@ -279,6 +279,111 @@ FIXTURES = {
                 return t1 - t0
             """,
     },
+    "FTP011": {
+        "positive": """
+            import threading
+            class Pump:
+                def __init__(self):
+                    self.rows = []
+                def start(self):
+                    t = threading.Thread(target=self._worker)
+                    t.start()
+                    self.rows.append("started")   # races with the worker
+                def _worker(self):
+                    self.rows.append("tick")
+            """,
+        "negative": """
+            import threading
+            class Pump:
+                def __init__(self):
+                    self.rows = []
+                    self._lock = threading.Lock()
+                def start(self):
+                    t = threading.Thread(target=self._worker)
+                    t.start()
+                    with self._lock:
+                        self.rows.append("started")
+                def _worker(self):
+                    with self._lock:
+                        self.rows.append("tick")
+            """,
+        "suppressed": """
+            import threading
+            class Pump:
+                def __init__(self):
+                    self.rows = []
+                def start(self):
+                    t = threading.Thread(target=self._worker)
+                    t.start()
+                    self.rows.append("started")  # fedtpu: noqa[FTP011] fixture
+                def _worker(self):
+                    self.rows.append("tick")
+            """,
+    },
+    "FTP012": {
+        "positive": """
+            import signal
+            import threading
+            class Ctl:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.mode = None
+                def install(self):
+                    signal.signal(signal.SIGUSR1, self._on_sig)
+                def _on_sig(self, signum, frame):
+                    with self._lock:
+                        self.mode = "shrink"
+            """,
+        "negative": """
+            import signal
+            class Ctl:
+                def __init__(self):
+                    self.mode = None
+                def install(self):
+                    signal.signal(signal.SIGUSR1, self._on_sig)
+                def _on_sig(self, signum, frame):
+                    if self.mode is None:
+                        self.mode = "shrink"    # flag store: reentrant-safe
+            """,
+        "suppressed": """
+            import signal
+            import threading
+            class Ctl:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.mode = None
+                def install(self):
+                    signal.signal(signal.SIGUSR1, self._on_sig)
+                def _on_sig(self, signum, frame):
+                    with self._lock:  # fedtpu: noqa[FTP012] fixture
+                        self.mode = "shrink"
+            """,
+    },
+    "FTP013": {
+        "positive": """
+            import json
+            import time
+            def emit(fh, row):
+                row = dict(row)
+                row["stamp"] = time.time()
+                fh.write(json.dumps(row, sort_keys=True) + "\\n")
+            """,
+        "negative": """
+            import json
+            import time
+            def emit(fh, members, spent):
+                row = {"members": sorted(members), "spent_s": spent}
+                fh.write(json.dumps(row, sort_keys=True) + "\\n")
+            """,
+        "suppressed": """
+            import json
+            import time
+            def emit(fh, row):
+                row = dict(row)
+                row["stamp"] = time.time()
+                fh.write(json.dumps(row, sort_keys=True) + "\\n")  # fedtpu: noqa[FTP013] fixture
+            """,
+    },
     "FTP101": {
         "positive": """
             def f(xs=[]):
@@ -437,6 +542,137 @@ def test_lint_paths_walks_and_dedupes(tmp_path):
 
 
 # --------------------------------------------------------------- reporters
+# ----------------------------------------- interprocedural rules (FTP011-013)
+def test_ftp011_event_barrier_negative():
+    """The scheduler's prefetch/writeback archetype: a cross-thread
+    write/read pair ordered by an Event wait/set handoff is NOT a race."""
+    src = """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+        class Sched:
+            def __init__(self):
+                self._pool = ThreadPoolExecutor(max_workers=1)
+                self._wb_done = threading.Event()
+                self._state = None
+            def _prepare(self, wb_done):
+                wb_done.wait(5.0)
+                return self._state          # read AFTER writeback commits
+            def run_chunk(self):
+                self._wb_done = threading.Event()
+                self._pool.submit(self._prepare, self._wb_done)
+                self._state = {"round": 1}  # writeback...
+                self._wb_done.set()         # ...then release the reader
+        """
+    assert "FTP011" not in codes(src)
+
+
+def test_ftp011_unlocked_cross_thread_write_fires_interprocedurally():
+    """The write happens two calls deep from the thread entry — only an
+    interprocedural flow sees it."""
+    src = """
+        import threading
+        class Relay:
+            def __init__(self):
+                self.count = 0
+            def start(self):
+                t = threading.Thread(target=self._loop)
+                t.start()
+            def _loop(self):
+                self._tick()
+            def _tick(self):
+                self.count += 1
+            def stats(self):
+                return self.count
+        """
+    assert "FTP011" in codes(src)
+
+
+def test_ftp011_prestart_writes_are_happens_before():
+    """Writes in the starting function BEFORE .start() cannot race with
+    the thread they configure (the netproxy port/_lsock pattern)."""
+    src = """
+        import threading
+        class Relay:
+            def __init__(self):
+                self.port = 0
+            def start(self):
+                self.port = 4242            # before start(): ordered
+                t = threading.Thread(target=self._loop)
+                t.start()
+            def _loop(self):
+                use(self.port)
+        """
+    assert "FTP011" not in codes(src)
+
+
+def test_ftp012_factory_returned_handler_resolves():
+    """reshard archetype: the handler is a closure returned by a factory
+    — registration by `signal.signal(sig, self._make(m))` still scans
+    the closure body."""
+    src = """
+        import signal
+        import threading
+        class Ctl:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.mode = None
+            def install(self):
+                signal.signal(signal.SIGUSR1, self._make("shrink"))
+            def _make(self, mode):
+                def _handler(signum, frame):
+                    with self._lock:
+                        self.mode = mode
+                return _handler
+        """
+    assert "FTP012" in codes(src)
+
+
+def test_ftp012_handler_reached_io_two_calls_deep():
+    src = """
+        import signal
+        def install(report):
+            def _handler(signum, frame):
+                _note(report)
+            signal.signal(signal.SIGTERM, _handler)
+        def _note(report):
+            print("caught")      # I/O + allocation off the safe list
+        """
+    assert "FTP012" in codes(src)
+
+
+def test_ftp013_set_iteration_without_sort_keys_fires():
+    src = """
+        import json
+        def emit(fh, ids):
+            members = set(ids)
+            fh.write(json.dumps({"members": list(members)}) + "\\n")
+        """
+    assert "FTP013" in codes(src)
+
+
+def test_ftp013_compact_separators_without_sort_keys_fires():
+    """Compact separators declare canonical intent (the golden-writer
+    signature); omitting sort_keys there leaks dict insertion order."""
+    src = """
+        import json
+        def send(sock, obj):
+            sock.sendall(json.dumps(obj, separators=(",", ":")).encode())
+        """
+    assert "FTP013" in codes(src)
+
+
+def test_ftp013_wall_clock_allowed_inside_timing_module():
+    src = """
+        import json
+        import time
+        def emit(fh):
+            row = {"t": time.perf_counter()}
+            fh.write(json.dumps(row, sort_keys=True) + "\\n")
+        """
+    assert "FTP013" not in codes(src, path="fedtpu/utils/timing.py")
+    assert "FTP013" in codes(src, path="fedtpu/other.py")
+
+
 def test_text_reporter_golden():
     result = lint_source('def f():\n    print("hi")\n', "pkg/mod.py")
     assert render_text(result) == (
@@ -463,6 +699,48 @@ def test_json_reporter_schema():
     assert finding["line"] == 2
     # Machine-readable rule catalog rides along.
     assert set(payload["rules"]) == set(RULES)
+
+
+def test_sarif_reporter_round_trip():
+    """`--format sarif` (satellite): valid SARIF 2.1.0 shape, every
+    registered rule in the driver catalog, findings and suppressions
+    round-trip with 1-based columns and source-relative URIs."""
+    from fedtpu.analysis.reporters import render_sarif
+
+    src = ('def f():\n    print("hi")\n'
+           'def g():\n    print("ho")  # fedtpu: noqa[FTP005] fixture\n')
+    result = lint_source(src, "pkg/mod.py")
+    sarif = json.loads(render_sarif(result))
+    assert sarif["version"] == "2.1.0"
+    (run,) = sarif["runs"]
+    assert run["tool"]["driver"]["name"] == "fedtpu-lint"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == set(RULES)
+    live = [r for r in run["results"] if "suppressions" not in r]
+    supp = [r for r in run["results"] if "suppressions" in r]
+    assert len(live) == 1 and len(supp) == 1
+    loc = live[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "pkg/mod.py"
+    assert loc["region"]["startLine"] == 2
+    assert loc["region"]["startColumn"] == 5       # 1-based for SARIF
+    assert live[0]["ruleId"] == supp[0]["ruleId"] == "FTP005"
+    assert supp[0]["suppressions"][0]["kind"] == "inSource"
+    # Round-trip: the SARIF results reconstruct the engine's findings.
+    got = {(r["ruleId"],
+            r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+            r["locations"][0]["physicalLocation"]["region"]["startLine"])
+           for r in live}
+    want = {(f.rule, f.path, f.line) for f in result.findings}
+    assert got == want
+
+
+def test_cli_lint_format_sarif(tmp_path, capsys):
+    from fedtpu.cli import main as cli_main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    print('x')\n")
+    assert cli_main(["lint", str(bad), "--format", "sarif"]) == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["runs"][0]["results"][0]["ruleId"] == "FTP005"
 
 
 # --------------------------------------------------------------------- CLI

@@ -1,0 +1,186 @@
+"""Regression tests for the real findings the FTP011/FTP012/FTP013 pass
+surfaced (PR 17 satellite: every fixed finding keeps a test).
+
+- netproxy `_threads`: appended from the accept-loop thread while
+  `stop()` iterated it from the main thread, unlocked (FTP011).
+- MetricsRegistry: lock-free `+=` counters incremented from
+  CompileExecutor's worker thread lost updates (FTP011-class).
+- reshard signal handler: took `self._sig_lock` inside the handler —
+  a self-deadlock against the main-thread frame it interrupts (FTP012).
+- protocol.send_msg: compact separators without sort_keys — frame bytes
+  were insertion-order-dependent on a deterministic-counter path
+  (FTP013).
+"""
+
+import json
+import signal
+import socket
+import threading
+
+from fedtpu.analysis.engine import lint_paths
+
+
+def _rule_clean(path: str, code: str) -> None:
+    res = lint_paths([path], select=[code])
+    assert not res.findings, [f"{f.path}:{f.line}: {f.message}"
+                              for f in res.findings]
+
+
+# ------------------------------------------------------- netproxy threads
+def test_netproxy_thread_list_is_lock_guarded():
+    """The accept loop and stop() now exchange `_threads` under `_lock`;
+    the interprocedural rule that caught the race must stay clean."""
+    _rule_clean("fedtpu/serving/netproxy.py", "FTP011")
+
+
+def test_netproxy_stop_joins_threads_registered_concurrently():
+    from fedtpu.resilience.netfaults import NetFaultPlan
+    from fedtpu.serving.netproxy import NetFaultProxy
+
+    plan = NetFaultPlan.load({"faults": []}, num_gateways=1)
+    proxy = NetFaultProxy(plan=plan, gateway_index=0, backend_port=0,
+                          port_file="")
+    done = threading.Event()
+
+    def fake_conn():
+        done.wait(5.0)
+
+    # Simulate the accept loop registering per-connection threads from
+    # its own thread while the main thread stops the proxy.
+    def register():
+        for _ in range(16):
+            t = threading.Thread(target=fake_conn, daemon=True)
+            t.start()
+            with proxy._lock:
+                proxy._threads.append(t)
+
+    reg = threading.Thread(target=register, daemon=True)
+    reg.start()
+    reg.join(5.0)
+    done.set()
+    proxy.stop()                          # iterates a locked snapshot
+    assert len(proxy._threads) == 16
+    assert all(not t.is_alive() for t in proxy._threads)
+
+
+# --------------------------------------------------------- metrics locking
+def test_counter_increments_from_many_threads_do_not_lose_updates():
+    from fedtpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    n_threads, per_thread = 8, 5000
+
+    def work():
+        c = reg.counter("background_compiles")
+        for _ in range(per_thread):
+            c.inc()
+            reg.gauge("last").set(1.0)
+            reg.histogram("stale").observe(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    snap = reg.snapshot()
+    assert snap["counters"]["background_compiles"] == n_threads * per_thread
+    assert snap["histograms"]["stale"]["count"] == n_threads * per_thread
+
+
+def test_snapshot_and_reset_are_atomic_under_concurrent_updates():
+    from fedtpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    stop = threading.Event()
+
+    def churn():
+        while not stop.is_set():
+            reg.counter("ticks").inc()
+            reg.histogram("h").observe(2.0)
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            snap = reg.snapshot()
+            h = snap["histograms"].get("h")
+            if h is not None:
+                # A torn histogram would break count == sum/2 here.
+                assert h["sum"] == 2.0 * h["count"]
+        reg.reset()
+        assert set(reg.snapshot()["counters"]) <= {"ticks"}
+    finally:
+        stop.set()
+        t.join(5.0)
+
+
+def test_standalone_instruments_default_their_own_lock():
+    from fedtpu.telemetry.metrics import Counter, Gauge, Histogram
+
+    c, g, h = Counter(), Gauge(), Histogram()
+    c.inc(2.0)
+    g.set(3.0)
+    h.observe(1.0)
+    assert c.value == 2.0 and g.value == 3.0 and h.count == 1
+
+
+# -------------------------------------------------- reshard signal handler
+def test_reshard_signal_handler_is_lock_free():
+    """The handler is a plain flag store now — FTP012 must stay clean
+    and the controller must not grow the lock back."""
+    _rule_clean("fedtpu/resilience/reshard.py", "FTP012")
+    from fedtpu.resilience.reshard import ReshardController
+    ctl = ReshardController(process_count=2, process_index=0)
+    assert not hasattr(ctl, "_sig_lock")
+
+
+def test_reshard_handler_fires_while_main_thread_polls():
+    """The exact interleaving the old lock deadlocked on: the signal
+    arrives while the main thread is mid-poll. Lock-free, it just
+    stores the flag."""
+    from fedtpu.resilience.reshard import ReshardController
+
+    ctl = ReshardController(process_count=1, process_index=0)
+    ctl.install_signal_handlers()
+    try:
+        signal.raise_signal(signal.SIGUSR1)   # delivered on this thread
+        assert ctl.signal_pending
+        req = ctl._poll_signal(3)
+        assert req is not None and req.mode == "shrink"
+        assert not ctl.signal_pending
+        # First notice wins: a second signal of the other mode while one
+        # is pending must not overwrite it.
+        signal.raise_signal(signal.SIGUSR1)
+        signal.raise_signal(signal.SIGUSR2)
+        assert ctl.signal_pending
+        req = ctl._poll_signal(4)
+        assert req is not None and req.mode == "shrink"
+        ctl.clear_signal()
+    finally:
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+        signal.signal(signal.SIGUSR2, signal.SIG_DFL)
+
+
+# ----------------------------------------------------- protocol canonical
+def test_send_msg_bytes_are_canonical_across_insertion_order():
+    """Frame bytes feed the netlog's deterministic byte counters — the
+    same payload must serialize identically however it was built."""
+    _rule_clean("fedtpu/serving/protocol.py", "FTP013")
+    from fedtpu.serving.protocol import send_msg
+
+    def frame(obj) -> bytes:
+        a, b = socket.socketpair()
+        try:
+            send_msg(a, obj)
+            return b.recv(1 << 16)
+        finally:
+            a.close()
+            b.close()
+
+    one = frame({"kind": "update", "seq": 3, "client": 7})
+    two = frame({"client": 7, "kind": "update", "seq": 3})
+    assert one == two
+    assert one.endswith(b"\n")
+    decoded = json.loads(one)
+    assert decoded == {"kind": "update", "seq": 3, "client": 7}
+    assert one == (b'{"client":7,"kind":"update","seq":3}\n')

@@ -1,0 +1,181 @@
+"""Lock-order sanitizer (fedtpu/analysis/lockdep.py): cycle detection,
+drill determinism, the committed golden, and the check-gate fold.
+
+The golden (tests/goldens/lockdep.json) pins the fleet's lock
+discipline: two tracked locks, both leaf-level (zero nesting edges) —
+deadlock-free by construction. Any new lock, nesting edge, or dropped
+drill changes the canonical bytes and fails `fedtpu check --lockdep`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from fedtpu.analysis.lockdep import (DRILLS, LockGraph, TrackedLock,
+                                     compare_graph, default_golden_path,
+                                     render_graph, run_drills)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "goldens", "lockdep.json")
+
+
+# ------------------------------------------------------------ graph core
+def test_tracked_lock_is_a_real_lock():
+    g = LockGraph()
+    lk = TrackedLock("l", g)
+    assert lk.acquire()
+    assert lk.locked()
+    assert not lk.acquire(blocking=False)     # non-reentrant, like Lock
+    lk.release()
+    assert not lk.locked()
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+
+
+def test_nested_acquisition_records_an_edge():
+    g = LockGraph()
+    a, b = TrackedLock("a", g), TrackedLock("b", g)
+    with a:
+        with b:
+            pass
+    assert g.edges == {("a", "b")}
+    assert g.cycles() == []
+
+
+def test_abba_ordering_is_detected_as_a_cycle():
+    """The classic two-lock deadlock: A→B observed on one path, B→A on
+    another. Scripted on one thread — the ORDER graph is what matters,
+    not a live hang."""
+    g = LockGraph()
+    a, b = TrackedLock("a", g), TrackedLock("b", g)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert g.edges == {("a", "b"), ("b", "a")}
+    assert g.cycles() == [["a", "b"]]
+
+
+def test_three_lock_cycle_is_detected():
+    g = LockGraph()
+    locks = {n: TrackedLock(n, g) for n in "abc"}
+    for first, second in (("a", "b"), ("b", "c"), ("c", "a")):
+        with locks[first]:
+            with locks[second]:
+                pass
+    assert g.cycles() == [["a", "b", "c"]]
+
+
+def test_edges_recorded_per_thread_not_across_threads():
+    """Holding A on thread 1 while thread 2 takes B is not a nesting
+    edge — only the same thread's held stack orders acquisitions."""
+    g = LockGraph()
+    a, b = TrackedLock("a", g), TrackedLock("b", g)
+    a_held = threading.Event()
+    done = threading.Event()
+
+    def other():
+        a_held.wait(5.0)
+        with b:
+            pass
+        done.set()
+
+    t = threading.Thread(target=other, daemon=True)
+    t.start()
+    with a:
+        a_held.set()
+        done.wait(5.0)
+    t.join(5.0)
+    assert g.edges == set()
+
+
+def test_failed_nonblocking_acquire_leaves_stack_clean():
+    g = LockGraph()
+    a = TrackedLock("a", g)
+    assert a.acquire()
+    assert not a.acquire(blocking=False)
+    a.release()
+    b = TrackedLock("b", g)
+    with b:                        # nothing spuriously held from above
+        pass
+    assert g.edges == set()
+
+
+# ---------------------------------------------------------------- drills
+def test_drills_are_deterministic():
+    first = render_graph(*run_drills())
+    for _ in range(2):
+        assert render_graph(*run_drills()) == first
+
+
+def test_drills_match_committed_golden_bitwise():
+    """Acceptance: the four pinned drills reproduce the committed golden
+    byte for byte, and the discipline they pin is edge-free."""
+    graph, ran = run_drills()
+    assert [name for name, _ in DRILLS] == sorted(ran)
+    cmp = compare_graph(render_graph(graph, ran), GOLDEN)
+    assert cmp["ok"], cmp["reason"]
+    assert graph.edges == set()          # every lock is leaf-level
+    assert graph.cycles() == []
+    assert {"netproxy._lock", "watchdog._lock"} == graph.nodes
+
+
+def test_golden_covers_required_drills():
+    payload = json.loads(open(GOLDEN, encoding="utf-8").read())
+    assert payload["drills"] == ["netproxy_relay", "overlap_compile",
+                                 "prefetch_writeback",
+                                 "watchdog_arm_disarm"]
+    assert payload["edges"] == [] and payload["cycles"] == []
+
+
+def test_tampered_golden_fails_the_gate(tmp_path):
+    graph, ran = run_drills()
+    rendered = render_graph(graph, ran)
+    bad = tmp_path / "lockdep.json"
+    bad.write_text(rendered.replace('"edges":[]',
+                                    '"edges":[["a","b"],["b","a"]]'))
+    cmp = compare_graph(rendered, str(bad))
+    assert not cmp["ok"]
+    assert "diverges" in cmp["reason"]
+    missing = compare_graph(rendered, str(tmp_path / "absent.json"))
+    assert not missing["ok"] and "unreadable" in missing["reason"]
+
+
+def test_default_golden_path_resolves_to_committed_file():
+    assert os.path.abspath(default_golden_path()) == os.path.abspath(GOLDEN)
+    assert os.path.exists(default_golden_path())
+
+
+# ------------------------------------------------------------- check gate
+@pytest.mark.slow
+def test_check_lockdep_folds_into_exit_code(tmp_path):
+    """`fedtpu check --lockdep` passes against the committed golden and
+    fails against a tampered one. Subprocess: check pins the platform
+    at import time."""
+    out = subprocess.run(
+        [sys.executable, "-m", "fedtpu.cli", "check", "--json",
+         "--lockdep"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    rep = json.loads(out.stdout)
+    assert rep["lockdep"]["ok"] is True
+    assert rep["lockdep"]["cycles"] == []
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "fedtpu.cli", "check", "--json",
+         "--lockdep", "--lockdep-golden", str(bad)],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode != 0
+    rep = json.loads(out.stdout)
+    assert rep["lockdep"]["ok"] is False
