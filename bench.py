@@ -22,6 +22,11 @@ must pass ``assert_above_flops_floor``: sec/round >= program FLOPs /
 dispatch-cancelling matmul-chain slope. A floor violation crashes the
 benchmark rather than recording a fantasy number.
 
+The ``mpmd_sync`` row reruns the synchronous early-stopping loop shape
+through the ``--mpmd`` DAG (PR 18, ``fedtpu/orchestration/mpmd.py``)
+with bitwise metric-history parity re-proven in-run; see
+``bench_mpmd_sync``.
+
 Baseline: the reference publishes no numbers (BASELINE.md), so the baseline
 is MEASURED here as a faithful single-host simulation of the reference's
 per-round work under ``mpirun -np 8`` (FL_CustomMLP...:63-120): per rank a
@@ -294,6 +299,179 @@ def bench_mfu_capability(peak: float) -> dict:
             "mfu": flops / (marginal * peak)}
 
 
+# BENCH_r05's recorded rps=100 operating point on the tunneled TPU
+# transport: pipelined 7.088e-5 s/round — i.e. 7.088e-3 s of overlapped
+# dispatch+compute per 100-round chunk — against synchronous 1.039e-3
+# s/round. The 9.68e-2 s/chunk difference is the serialized
+# dispatch+fetch RTT the sync loop pays per chunk and the pipelined
+# loop hides; it is the input to the clearly-labeled schedule model in
+# bench_mpmd_sync (the measured improvement is reported alongside it).
+TUNNEL_CHUNK_COMPUTE_S = 7.088e-5 * HEADLINE_RPS
+TUNNEL_RTT_S = (1.039e-3 - 7.088e-5) * HEADLINE_RPS
+
+
+def bench_mpmd_sync(ds, peak: float) -> dict:
+    """Sync-mode MPMD row: the early-stopping loop shape rerun through
+    the ``--mpmd`` DAG (fedtpu/orchestration/mpmd.py).
+
+    The monolithic sync loop blocks on a metric fetch after every chunk
+    — dispatch + compute + fetch serialized per chunk, the 15x gap the
+    sweep's sync column records on the tunneled transport. The MPMD loop
+    is the production ``RunConfig.mpmd`` schedule: the whole DAG is
+    enqueued async (client chain on the round mesh, the metrics
+    program's tiny output pushed eagerly to the server submesh) and the
+    early-stop decision lags one in-flight chunk, so chunk k's fetch
+    drains under chunk k+1's compute and the RTT leaves the critical
+    path.
+
+    Parity is load-bearing and CRASHES on failure: the two loops'
+    fetched metric histories and final states must be bitwise equal —
+    the tests/test_mpmd.py oracle contract, re-proven inside the
+    artifact every run.
+
+    Two improvement numbers ride in the row. ``improvement_measured``
+    is real on THIS backend's transport: on the tunneled TPU transport
+    the hidden RTT is ~0.1 s/chunk and the ratio lands near the sync/
+    pipelined split; on a local CPU backend the RTT is ~0 and the ratio
+    is honestly ~1. ``improvement_modeled_tunnel`` is a deterministic
+    schedule model at BENCH_r05's recorded rps=100 tunnel operating
+    point (constants above): the lag-1 pending schedule takes the
+    per-chunk RTT off the critical path, so the improvement is
+    (chunk_compute + rtt) / chunk_compute — a model, labeled as such,
+    with its inputs in the row.
+    """
+    import jax
+
+    from fedtpu.analysis.guards import RecompileSentinel
+    from fedtpu.config import (ExperimentConfig, ModelConfig, OptimConfig,
+                               RunConfig, ShardConfig)
+    from fedtpu.data.sharding import pack_clients
+    from fedtpu.models import build_model
+    from fedtpu.ops import build_optimizer
+    from fedtpu.orchestration.mpmd import build_mpmd_step
+    from fedtpu.parallel import make_mesh, client_sharding
+    from fedtpu.parallel.round import build_round_fn, init_federated_state
+    from fedtpu.utils.timing import (assert_above_flops_floor,
+                                     compile_with_flops, force_fetch)
+    from fedtpu.utils.trees import clone
+
+    rps = HEADLINE_RPS
+    mesh = make_mesh(num_clients=NUM_CLIENTS)
+    shard = client_sharding(mesh)
+    packed = pack_clients(ds.x_train, ds.y_train,
+                          ShardConfig(num_clients=NUM_CLIENTS))
+    batch = {k: jax.device_put(v, shard) for k, v in
+             {"x": packed.x, "y": packed.y, "mask": packed.mask}.items()}
+    init_fn, apply_fn = build_model(ModelConfig(input_dim=ds.input_dim,
+                                                num_classes=ds.num_classes))
+    tx = build_optimizer(OptimConfig())
+    state0 = init_federated_state(jax.random.key(0), mesh, NUM_CLIENTS,
+                                  init_fn, tx)
+
+    mono = build_round_fn(mesh, apply_fn, tx, ds.num_classes,
+                          rounds_per_step=rps)
+    mono, flops = compile_with_flops(mono, clone(state0), batch)
+    cfg = ExperimentConfig(
+        model=ModelConfig(input_dim=ds.input_dim,
+                          num_classes=ds.num_classes),
+        shard=ShardConfig(num_clients=NUM_CLIENTS),
+        run=RunConfig(mpmd=True, rounds_per_step=rps))
+    mpmd = build_mpmd_step(cfg, mesh=mesh, apply_fn=apply_fn, tx=tx,
+                           num_classes=ds.num_classes, state=state0,
+                           batch=batch, width=rps)
+
+    chunks = 6
+    sentinel = RecompileSentinel(label="bench_mpmd_sync")
+
+    def fetched(m):
+        force_fetch(m)
+        return jax.tree.map(np.asarray, m)
+
+    # Warm one chunk through each engine (absorbs one-time transfer
+    # programs) before the armed, timed windows.
+    _, m = mono(clone(state0), batch)
+    force_fetch(m)
+    _, m = mpmd(clone(state0), batch)
+    force_fetch(m)
+
+    # Monolithic sync loop: block on the metrics after every chunk.
+    s = clone(state0)
+    hist_mono = []
+    with sentinel.armed():
+        t0 = time.perf_counter()
+        for _ in range(chunks):
+            s, m = mono(s, batch)
+            hist_mono.append(fetched(m))
+        mono_sync_s = (time.perf_counter() - t0) / (chunks * rps)
+    state_mono = jax.tree.map(np.asarray, s)
+
+    # MPMD sync loop: the production one-chunk pending lag — dispatch
+    # chunk k+1's DAG, THEN drain chunk k's already-pushed metrics.
+    s = clone(state0)
+    hist_mpmd = []
+    pend = None
+    dispatch = []
+    with sentinel.armed():
+        t0 = time.perf_counter()
+        for _ in range(chunks):
+            td = time.perf_counter()
+            s, m = mpmd(s, batch)
+            dispatch.append(time.perf_counter() - td)
+            if pend is not None:
+                hist_mpmd.append(fetched(pend))
+            pend = m
+        hist_mpmd.append(fetched(pend))
+        mpmd_sync_s = (time.perf_counter() - t0) / (chunks * rps)
+    state_mpmd = jax.tree.map(np.asarray, s)
+
+    bad = 0
+    for a, b in zip(hist_mono, hist_mpmd):
+        if jax.tree.structure(a) != jax.tree.structure(b):
+            raise RuntimeError("--mpmd sync row: metric tree structure "
+                               "diverged from the monolithic oracle")
+        bad += sum(not np.array_equal(x, y) for x, y in
+                   zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+    bad += sum(not np.array_equal(x, y) for x, y in
+               zip(jax.tree.leaves(state_mono),
+                   jax.tree.leaves(state_mpmd)))
+    if bad:
+        raise RuntimeError(
+            f"--mpmd sync row lost bitwise parity with the monolithic "
+            f"oracle: {bad} leaves differ across {chunks} chunks")
+
+    assert_above_flops_floor(mono_sync_s, flops, peak,
+                             label="mpmd-row mono sync")
+    assert_above_flops_floor(mpmd_sync_s, flops, peak,
+                             label="mpmd-row mpmd sync")
+
+    # Host dispatch cost per chunk — a DIAGNOSTIC, not a model input: on
+    # an async transport (the tunnel) it is the DAG enqueue cost; on a
+    # synchronous local backend the call blocks through the compute and
+    # this number degenerates to ~chunk compute.
+    host_dispatch_s = float(np.median(dispatch))
+    # The schedule model, at BENCH_r05's recorded operating point only:
+    # the lag-1 pending schedule removes the per-chunk dispatch+fetch
+    # RTT from the critical path (chunk k's fetch drains under chunk
+    # k+1's compute), so sync-mode cost collapses to the pipelined
+    # chunk cost and the improvement is (compute + rtt) / compute.
+    modeled = (TUNNEL_CHUNK_COMPUTE_S + TUNNEL_RTT_S) \
+        / TUNNEL_CHUNK_COMPUTE_S
+    return {"rounds_per_step": rps,
+            "sync_s": mono_sync_s,
+            "mpmd_sync_s": mpmd_sync_s,
+            "improvement_measured": mono_sync_s / mpmd_sync_s,
+            "parity_bitwise": True,
+            "chunks_compared": chunks,
+            "recompiles": sentinel.count,
+            "host_dispatch_s": host_dispatch_s,
+            "improvement_modeled_tunnel": modeled,
+            "model": {"tunnel_chunk_compute_s": TUNNEL_CHUNK_COMPUTE_S,
+                      "tunnel_rtt_s": TUNNEL_RTT_S,
+                      "source": "BENCH_r05 rps=100 recorded sync vs "
+                                "pipelined split; lag-1 schedule takes "
+                                "the rtt off the critical path"}}
+
+
 def bench_reference_equivalent(ds) -> dict:
     """Measured reference-equivalent baseline; see module docstring."""
     import torch
@@ -426,6 +604,8 @@ def main(argv=None):
         ours = bench_fedtpu(ds)
     with tracer.span("mfu_capability"):
         capability = bench_mfu_capability(ours["peak_flops_measured"])
+    with tracer.span("mpmd_sync"):
+        mpmd_row = bench_mpmd_sync(ds, ours["peak_flops_measured"])
     with tracer.span("baseline"):
         base = bench_reference_equivalent(ds)
     lo, hi = ours["sec_per_round_range"]
@@ -469,6 +649,30 @@ def main(argv=None):
                              "sync_s": g3(row["sec_per_round_sync"]),
                              "mfu": g3(row["mfu"])}
                   for rps, row in ours["sweep"].items()},
+        # PR 18 --mpmd sync-mode row (bench_mpmd_sync): the early-stop
+        # loop shape through the MPMD DAG, bitwise metric-history parity
+        # re-proven in-run (the bench crashes otherwise). The measured
+        # ratio is this backend's transport; the modeled ratio is the
+        # BENCH_r05 tunnel operating point, labeled as a model with its
+        # inputs alongside.
+        "mpmd_sync": {
+            "rounds_per_step": mpmd_row["rounds_per_step"],
+            "sync_s": g3(mpmd_row["sync_s"]),
+            "mpmd_sync_s": g3(mpmd_row["mpmd_sync_s"]),
+            "improvement_measured": g3(mpmd_row["improvement_measured"]),
+            "improvement_modeled_tunnel": g3(
+                mpmd_row["improvement_modeled_tunnel"]),
+            "parity_bitwise": mpmd_row["parity_bitwise"],
+            "chunks_compared": mpmd_row["chunks_compared"],
+            "recompiles": mpmd_row["recompiles"],
+            "host_dispatch_s": g3(mpmd_row["host_dispatch_s"]),
+            "model": {
+                "tunnel_chunk_compute_s": g3(
+                    mpmd_row["model"]["tunnel_chunk_compute_s"]),
+                "tunnel_rtt_s": g3(mpmd_row["model"]["tunnel_rtt_s"]),
+                "source": mpmd_row["model"]["source"],
+            },
+        },
         "baseline": {
             "sec_per_round": g3(base["sec_per_round"]),
             "assumed_parallelism": base["assumed_parallelism"],
@@ -516,6 +720,15 @@ def main(argv=None):
             f"MFU {100 * row['mfu']:.1f}%, "
             f"{row['rounds_timed']} rounds/window, "
             f"{row['rounds_trained']} trained)")
+    detail.append(
+        f"[bench] mpmd sync-mode (rps={mpmd_row['rounds_per_step']}, --mpmd "
+        f"DAG, one-chunk lag): {mpmd_row['mpmd_sync_s']:.3e} s/round vs "
+        f"monolithic sync {mpmd_row['sync_s']:.3e} — measured "
+        f"{mpmd_row['improvement_measured']:.2f}x on this transport, "
+        f"modeled {mpmd_row['improvement_modeled_tunnel']:.1f}x at the "
+        f"BENCH_r05 tunnel operating point; metric history + final state "
+        f"bitwise over {mpmd_row['chunks_compared']} chunks, "
+        f"{mpmd_row['recompiles']} in-window recompiles")
     detail.append(
         f"[bench] baseline(measured reference-equivalent): {base} — "
         "compute credited /min(8, cpu_count); an 8-core host shrinks "

@@ -263,6 +263,12 @@ def engine_audit_spec(cfg) -> dict:
     if cfg.run.model_parallel > 1:
         from fedtpu.parallel import tp
         return tp.AUDIT_SPEC
+    if getattr(cfg.run, "mpmd", False):
+        # The MPMD DAG's headline sub-program (the chain holds the round
+        # math and the donated state); the per-sub-program specs live in
+        # mpmd.AUDIT_SPECS and audit under the mpmd_* engine probes.
+        from fedtpu.orchestration import mpmd
+        return mpmd.AUDIT_SPEC
     from fedtpu.parallel import round as round_mod
     return round_mod.AUDIT_SPEC
 
@@ -382,11 +388,31 @@ def _probe_cohort(cfg):
     return step, (state, xs), scheduler.AUDIT_SPEC, mesh, True
 
 
+def _probe_mpmd(name: str):
+    """One probe per MPMD sub-program (fedtpu.orchestration.mpmd): the
+    DAG's collective schedules are gated INDEPENDENTLY — the client and
+    metrics programs must stay collective-free, the aggregate/chain own
+    the clients-axis reductions. Not part of AUDIT_ENGINES (the default
+    golden set is pinned); audited via ``--engines mpmd_client,...``
+    into their own goldens (tests/goldens/audit_mpmd_*.json)."""
+
+    def probe(cfg):
+        from fedtpu.orchestration import mpmd
+        step, args, spec, mesh = mpmd.audit_probes(cfg)[name]
+        return step, args, spec, mesh, True
+
+    return probe
+
+
 _PROBES = {
     "sync": _probe_sync,
     "async": _probe_async,
     "tp": _probe_tp,
     "cohort": _probe_cohort,
+    "mpmd_client": _probe_mpmd("mpmd_client"),
+    "mpmd_aggregate": _probe_mpmd("mpmd_aggregate"),
+    "mpmd_chain": _probe_mpmd("mpmd_chain"),
+    "mpmd_metrics": _probe_mpmd("mpmd_metrics"),
 }
 
 

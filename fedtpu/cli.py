@@ -369,6 +369,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         run_kw["log_per_client"] = True
     if getattr(args, "pipelined_stop", False):
         run_kw["pipelined_stop"] = True
+    if getattr(args, "mpmd", False):
+        run_kw["mpmd"] = True
     if getattr(args, "model_parallel", None) is not None:
         run_kw["model_parallel"] = args.model_parallel
     if getattr(args, "fault_plan", None) is not None:
@@ -559,6 +561,15 @@ def build_parser() -> argparse.ArgumentParser:
                             "chunk's device execution; stop decisions lag "
                             "one chunk (recorded history stays identical "
                             "to the synchronous loop)")
+    run_p.add_argument("--mpmd", action="store_true",
+                       help="MPMD round pipelining: the round chunk as a "
+                            "DAG of AOT sub-programs (client-step / "
+                            "aggregate / metrics) with async dispatch and "
+                            "a server-submesh metrics placement — hides "
+                            "the per-round metric-fetch RTT under the "
+                            "next chunk's client compute; bitwise metric "
+                            "history vs the default monolithic path "
+                            "(subsumes --pipelined-stop)")
     run_p.add_argument("--overlap-compile", action="store_true",
                        help="with --rounds-per-step R>1, train R=1 warmup "
                             "rounds while the R-wide chunk program compiles "
@@ -826,6 +837,13 @@ def build_parser() -> argparse.ArgumentParser:
                               "over the package plus the jaxpr-level "
                               "program audit ('fedtpu audit') of the same "
                               "preset — folded into the exit code")
+    check_p.add_argument("--mpmd", action="store_true",
+                         help="also run the MPMD parity probe: the same "
+                              "preset twice on small synthetic data — "
+                              "monolithic oracle vs the MPMD DAG — with "
+                              "the metric history and final parameters "
+                              "compared bitwise, folded into the exit "
+                              "code")
     check_p.add_argument("--autoscale-sim", default=None, metavar="GOLDEN",
                          help="also replay the pinned autoscale "
                               "simulation and compare its decision "
@@ -1565,6 +1583,17 @@ def main(argv=None) -> int:
             }
             report["ok"] = (report["ok"] and audit["ok"]
                             and report["lint"]["clean"])
+        if args.mpmd:
+            # Fold the MPMD parity probe into the check: the DAG of AOT
+            # sub-programs must reproduce the monolithic oracle's metric
+            # history and final parameters BITWISE — any reassociated
+            # cross-client sum, sharding drift inside a sub-program, or
+            # round dropped at a chunk boundary fails the gate.
+            from fedtpu.orchestration.mpmd import parity_check
+            par = parity_check(args.preset, rounds=args.rounds,
+                               synthetic_rows=args.synthetic_rows)
+            report["mpmd_parity"] = par
+            report["ok"] = report["ok"] and par["ok"]
         if args.autoscale_sim:
             # Fold the pinned control-plane simulation into the check:
             # the decision sequence must match the committed golden
@@ -1674,6 +1703,12 @@ def main(argv=None) -> int:
             if "audit" in report:
                 print(f"audit: ok={report['audit']['ok']} "
                       f"digests={report['audit']['digests']}")
+            if "mpmd_parity" in report:
+                m = report["mpmd_parity"]
+                print(f"mpmd-parity: ok={m['ok']} "
+                      f"rounds_run={m['rounds_run']} width={m['width']} "
+                      f"metric_mismatches={m['metric_mismatches']} "
+                      f"param_leaf_mismatches={m['param_leaf_mismatches']}")
             if "autoscale_sim" in report:
                 a = report["autoscale_sim"]
                 print(f"autoscale-sim: ok={a['ok']} ({a['reason']})")

@@ -129,8 +129,14 @@ def _mesh_signature(mesh: Any) -> Any:
     if mesh is None:
         return None
     try:
+        # device_ids makes the *assignment* part of the key, not just the
+        # extent: two equal-sized slices of one parent mesh (MPMD client
+        # slice vs server slice) compile against different device sets and
+        # must never share an executable.
         return {"shape": [[str(k), int(v)] for k, v in mesh.shape.items()],
-                "devices": int(mesh.devices.size)}
+                "devices": int(mesh.devices.size),
+                "device_ids": [[str(getattr(d, "platform", "?")), int(d.id)]
+                               for d in mesh.devices.flat]}
     except Exception:
         return repr(mesh)
 

@@ -340,6 +340,16 @@ class RunConfig:
     # mode it never trains past the stop; tests/test_stop_lag.py.)
     # Default off: exact synchronous stop semantics.
     pipelined_stop: bool = False
+    # MPMD round pipelining (fedtpu.orchestration.mpmd): the monolithic
+    # jitted chunk decomposed into a static DAG of AOT sub-programs
+    # (client-step / aggregate / metrics) with async dispatch and
+    # cross-program donation, the metrics program placed on a server
+    # submesh slice. Subsumes pipelined_stop (one chunk stays in flight;
+    # stop decisions lag one chunk) while hiding the per-round metric
+    # fetch RTT under the next chunk's client compute. Plain synchronous
+    # FedAvg/FedProx path only; bitwise-identical metric history and
+    # final params vs the monolithic oracle (tests/test_mpmd.py).
+    mpmd: bool = False
     # >1 selects the 2-D ('clients','model') GSPMD engine
     # (fedtpu.parallel.tp): hidden weights shard over a tensor-parallel axis
     # of this extent. MLP only; partial participation unsupported there.

@@ -228,6 +228,12 @@ class Experiment:
     # synchronous engines (every slot holds the post-average global), the
     # freshest anchor for the async engine (slots hold per-client models).
     global_fn: Callable = global_params
+    # Model/optimizer handles for engines that compile their own programs
+    # from the experiment's wiring (the MPMD DAG builds its sub-programs
+    # from these).
+    apply_fn: Optional[Callable] = None
+    tx: Optional[object] = None
+    num_classes: int = 0
 
 
 def build_experiment(cfg: ExperimentConfig,
@@ -488,7 +494,8 @@ def build_experiment(cfg: ExperimentConfig,
                                               cfg.fed.personalize_steps)
     return Experiment(make_step=step_fn, state=state, batch=batch,
                       eval_step=eval_step, dataset=ds, mesh=mesh,
-                      personalize_fn=personalize_fn, global_fn=global_fn)
+                      personalize_fn=personalize_fn, global_fn=global_fn,
+                      apply_fn=apply_fn, tx=tx, num_classes=ds.num_classes)
 
 
 @jax.jit
@@ -596,8 +603,33 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
                 "zero-mask client has aggregation weight mask.sum()=0 only "
                 "under data-size weighting (under 'uniform' it would still "
                 "average in at weight 1)")
+    if cfg.run.mpmd:
+        # MPMD DAG (fedtpu.orchestration.mpmd): same fail-fast contract —
+        # every engine knob the decomposition cannot honor is rejected
+        # before any build work.
+        from fedtpu.orchestration.mpmd import validate_mpmd_config
+        validate_mpmd_config(cfg)
+        if cfg.run.pipelined_stop:
+            raise ValueError(
+                "run.mpmd subsumes pipelined_stop (the DAG already keeps "
+                "one chunk in flight); set only one of the two")
+        if cfg.run.on_divergence == "rollback":
+            raise ValueError(
+                "on_divergence='rollback' is incompatible with mpmd for "
+                "the same reason as pipelined_stop: the divergence guard "
+                "fires one in-flight chunk late, after the restore "
+                "point's successor chunk already dispatched")
+        if cfg.run.overlap_compile:
+            raise ValueError(
+                "run.mpmd compiles every sub-program ahead of time; "
+                "overlap_compile has no monolithic chunk left to build "
+                "in the background")
 
     multiproc = jax.process_count() > 1
+    if cfg.run.mpmd and multiproc:
+        raise ValueError(
+            "run.mpmd is single-process: the DAG's cross-slice "
+            "device_put edge has no multihost transfer path")
     io_proc = jax.process_index() == 0
     verbose = verbose and io_proc
 
@@ -694,7 +726,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     reshard_stack: List[dict] = []     # pre-shrink bindings, for grow-back
     ckpt_group = None                  # surviving processes after a shrink
     reshard_live = (max(1, cfg.run.rounds_per_step) == 1
-                    and not cfg.run.pipelined_stop)
+                    and not cfg.run.pipelined_stop and not cfg.run.mpmd)
     if cfg.run.model_parallel == 1:
         from fedtpu.resilience.distributed import ENV_LAUNCH_ID
         from fedtpu.resilience.reshard import (ReshardController,
@@ -791,6 +823,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         manifest_extra = {"program": "run",
                           "engine": ("async" if cfg.fed.async_mode
                                      else "tp2d" if cfg.run.model_parallel > 1
+                                     else "mpmd" if cfg.run.mpmd
                                      else "sync1d"),
                           # Resilience attribution: which restart of a
                           # supervised run wrote this sink, under which
@@ -819,6 +852,19 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
             manifest_extra["audit"] = dict(
                 audit_step_summary(exp.make_step(1), (state, batch)),
                 engine=engine_audit_spec(cfg)["engine"])
+            if cfg.run.mpmd:
+                # Under mpmd the summary above still audits the
+                # monolithic ORACLE program (the parity reference); the
+                # per-sub-program contracts live in the committed
+                # `fedtpu audit --engines mpmd_*` goldens.
+                from fedtpu.orchestration.mpmd import AUDIT_SPECS
+                manifest_extra["audit"]["audited_program"] = \
+                    "monolithic_oracle"
+                manifest_extra["mpmd"] = {
+                    "sub_programs": sorted(AUDIT_SPECS),
+                    "width": max(1, cfg.run.rounds_per_step),
+                    "server_mesh_devices": 1,
+                }
         except Exception as exc:
             # The audit is diagnostic metadata; a trace failure must not
             # take down the run it describes.
@@ -1301,9 +1347,28 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
     chunk = max(1, cfg.run.rounds_per_step)
     step_fns: Dict[int, Callable] = {}
 
+    # MPMD sub-program cache: same directory layout as overlap_compile's
+    # (the <cache>/programs store), so a warmed cache serves both paths.
+    mpmd_cache = None
+    if cfg.run.mpmd and cfg.run.compilation_cache:
+        from fedtpu.compilation import ProgramCache
+        from fedtpu.compilation.warmup import PROGRAMS_SUBDIR
+        mpmd_cache = ProgramCache(
+            os.path.join(cfg.run.compilation_cache, PROGRAMS_SUBDIR),
+            tracer=tracer, registry=registry)
+
     def get_step(r: int) -> Callable:
         if r not in step_fns:
-            step_fns[r] = exp.make_step(r)
+            if cfg.run.mpmd:
+                # The DAG of AOT sub-programs; compiles (or loads from
+                # the cache) every sub-program at this width up front.
+                from fedtpu.orchestration.mpmd import build_mpmd_step
+                step_fns[r] = build_mpmd_step(
+                    cfg, mesh=exp.mesh, apply_fn=exp.apply_fn, tx=exp.tx,
+                    num_classes=exp.num_classes, state=state, batch=batch,
+                    width=r, cache=mpmd_cache, tracer=tracer)
+            else:
+                step_fns[r] = exp.make_step(r)
         return step_fns[r]
 
     jsonl = (open(cfg.run.metrics_jsonl, "a")
@@ -1799,7 +1864,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: Optional[Dataset] = None,
         # Checkpoint / held-out-eval boundaries force their inherent sync
         # and are unchanged. Default OFF: the synchronous loop keeps exact
         # reference stop semantics.
-        pipelined = cfg.run.pipelined_stop
+        # run.mpmd rides the same pending machinery: the DAG dispatches
+        # everything (chain, cross-slice transfer, metrics program)
+        # asynchronously, and this one-chunk-in-flight schedule is what
+        # overlaps chunk k's metric fetch under chunk k+1's client
+        # compute — the RTT-hiding half of the MPMD win.
+        pipelined = cfg.run.pipelined_stop or cfg.run.mpmd
         pending = None                      # (rnd0, take, metrics) in flight
         rnd = start_round
         while rnd < cfg.fed.rounds and not stopped_early:

@@ -315,6 +315,11 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         # wiring): schedule digest + comm bytes of the width-1 round.
         if manifest.get("audit"):
             out["static_analysis"] = manifest["audit"]
+        # MPMD DAG shape (run.mpmd): which sub-programs ran at what
+        # chunk width — the report's key for reading the per-sub-program
+        # trace spans against the right schedule.
+        if manifest.get("mpmd"):
+            out["manifest"]["mpmd"] = manifest["mpmd"]
     # Device-time attribution (docs/observability.md): join the
     # manifest's static XLA cost model (flops / bytes accessed of the
     # width-1 round, orchestration/loop.py manifest wiring) with the

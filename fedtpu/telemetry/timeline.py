@@ -49,8 +49,12 @@ from fedtpu.telemetry.report import load_events
 # Causal stage order of one update's trace chain. Within one engine
 # tick the stages can only advance left to right; dedup_drop is the
 # retry path's terminal stage (the original verdict was already acked).
+# The MPMD trio (client_step → aggregate → metrics) is one chunk's pass
+# through the DAG of sub-programs; appended at the END so existing
+# goldens' ranks never move.
 STAGES = ("client_stamp", "wal", "dedup_drop", "admit",
-          "buffer_insert", "incorporate")
+          "buffer_insert", "incorporate",
+          "client_step", "aggregate", "metrics")
 _STAGE_RANK = {s: i for i, s in enumerate(STAGES)}
 
 # Payload fields that are pure functions of the virtual-time campaign —

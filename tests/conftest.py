@@ -254,6 +254,13 @@ QUICK_TESTS = {
     "test_netfaults.py::test_scenario_registry_is_single_source_of_truth",
     "test_netfaults.py::test_line_cap_streams_bounded_and_connection"
     "_survives",
+    # round-13 modules
+    # MPMD round pipelining (PR 18): the width-1 two-program DAG parity
+    # run is the fastest compile in the module (~seconds); the golden
+    # contract check is pure JSON, milliseconds. The chain/SIGTERM/
+    # trace-chain parity runs stay full-tier.
+    "test_mpmd.py::test_mpmd_width1_matches_monolithic_bitwise",
+    "test_mpmd_audit_gate.py::test_mpmd_goldens_are_clean_contracts",
 }
 
 

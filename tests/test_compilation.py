@@ -143,6 +143,23 @@ def test_fingerprint_is_stable_across_concrete_and_abstract_args():
             == program_fingerprint("round", args=(sds,)))
 
 
+def test_fingerprint_separates_same_extent_slices_of_one_mesh():
+    """Two equal-sized slices of one parent mesh — the MPMD client slice
+    vs the server slice — compile against DIFFERENT device sets and must
+    never share a cache entry: the mesh signature carries the device
+    assignment, not just the axis extents. Identical slices still hit."""
+    from jax.sharding import Mesh
+    devs = np.asarray(jax.devices())
+    assert devs.size >= 8                 # conftest's 8-device CPU pin
+    lo = Mesh(devs[:4], ("clients",))
+    hi = Mesh(devs[4:8], ("clients",))
+    again = Mesh(devs[:4], ("clients",))
+    assert (program_fingerprint("round", mesh=lo)
+            == program_fingerprint("round", mesh=again))
+    assert (program_fingerprint("round", mesh=lo)
+            != program_fingerprint("round", mesh=hi))
+
+
 # ------------------------------------------------------------- the executor
 def test_executor_dedupes_blocks_and_reraises():
     calls = {"n": 0}

@@ -1,0 +1,214 @@
+"""MPMD round pipelining (RunConfig.mpmd): the round decomposed into a
+DAG of AOT sub-programs — client step, aggregate+apply, metrics — with
+async dispatch and the monolithic loop as bitwise-parity oracle.
+
+Semantics contract (fedtpu/orchestration/mpmd.py + loop.py):
+
+* the DAG's recorded metric history and final params are BITWISE equal
+  to the monolithic run (the sub-programs are built from the same
+  primitives in the same op order, and the metrics program compiles on
+  the client mesh so its cross-client sums partition identically);
+* mpmd rides the pipelined pending machinery: early-stop decisions lag
+  one in-flight chunk but the recorded history and the stop round match
+  the synchronous run exactly;
+* a SIGTERM drain mid-pipeline processes the in-flight chunk first, so
+  the checkpoint lands on a consistent chunk boundary and resume
+  reproduces the uninterrupted history;
+* faults that edit the live batch mask (client dropout) stay bitwise
+  because the metrics sub-program reads the mask per call, never a
+  build-time snapshot;
+* configs whose round math cannot split at the client/aggregate
+  boundary are rejected loudly at startup.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from fedtpu.config import (DataConfig, ExperimentConfig, FedConfig,
+                           ModelConfig, RunConfig, ShardConfig)
+from fedtpu.orchestration.loop import run_experiment
+from fedtpu.resilience.supervisor import Preempted
+
+
+def _cfg(**run_kw):
+    return ExperimentConfig(
+        data=DataConfig(csv_path=None, synthetic_rows=256,
+                        synthetic_features=6),
+        shard=ShardConfig(num_clients=4, shuffle=False),
+        model=ModelConfig(input_dim=6, hidden_sizes=(8,)),
+        fed=FedConfig(rounds=12, tolerance=0.0),
+        run=RunConfig(rounds_per_step=3, **run_kw),
+    )
+
+
+def _assert_bitwise(a, b):
+    assert set(a.global_metrics) == set(b.global_metrics)
+    for k in a.global_metrics:
+        np.testing.assert_array_equal(a.global_metrics[k],
+                                      b.global_metrics[k], err_msg=k)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)),
+        a.final_params, b.final_params)
+
+
+def test_mpmd_matches_monolithic_bitwise():
+    """Chain path (rounds_per_step=3): history AND final params."""
+    mono = run_experiment(_cfg(), verbose=False)
+    mp = run_experiment(_cfg(mpmd=True), verbose=False)
+    assert mp.rounds_run == mono.rounds_run == 12
+    _assert_bitwise(mono, mp)
+
+
+def test_mpmd_width1_matches_monolithic_bitwise():
+    """Two-program DAG (client -> aggregate, no scan): the degenerate
+    width where cross-program buffer handoff replaces the scan carry."""
+    def cfg(mpmd):
+        base = _cfg(mpmd=mpmd)
+        return dataclasses.replace(
+            base, fed=dataclasses.replace(base.fed, rounds=4),
+            run=dataclasses.replace(base.run, rounds_per_step=1))
+    mono = run_experiment(cfg(False), verbose=False)
+    mp = run_experiment(cfg(True), verbose=False)
+    assert mp.rounds_run == mono.rounds_run == 4
+    _assert_bitwise(mono, mp)
+
+
+def test_mpmd_early_stop_round_agreement():
+    # tolerance=1 makes every round "no significant change": both
+    # engines must stop at round patience+1 with identical recorded
+    # histories (the in-flight overshoot chunk's metrics are dropped,
+    # exactly like pipelined_stop).
+    def cfg(mpmd):
+        base = _cfg(mpmd=mpmd)
+        return dataclasses.replace(
+            base, fed=dataclasses.replace(base.fed, rounds=30,
+                                          tolerance=1.0,
+                                          termination_patience=4))
+    mono = run_experiment(cfg(False), verbose=False)
+    mp = run_experiment(cfg(True), verbose=False)
+    assert mono.stopped_early and mp.stopped_early
+    assert mp.rounds_run == mono.rounds_run
+    for k in mono.global_metrics:
+        np.testing.assert_array_equal(mono.global_metrics[k],
+                                      mp.global_metrics[k])
+
+
+def test_mpmd_sigterm_drain_lands_on_chunk_boundary_and_resumes(tmp_path):
+    """SIGTERM mid-pipeline: the drain processes the in-flight chunk
+    before checkpointing, so the saved round is a consistent boundary
+    (history rounds == state round), and resume completes the run with
+    the uninterrupted monolithic history bitwise."""
+    baseline = run_experiment(_cfg(), verbose=False)
+    ck = str(tmp_path / "ck")
+    plan = json.dumps({"seed": 0, "faults": [
+        {"kind": "process_kill", "round": 5, "signal": "SIGTERM"}]})
+    cfg = _cfg(mpmd=True, fault_plan=plan, checkpoint_dir=ck,
+               checkpoint_every=3)
+    with pytest.raises(Preempted) as exc:
+        run_experiment(cfg, verbose=False)
+    from fedtpu.orchestration.checkpoint import latest_step
+    drained = latest_step(ck)
+    # The fault fires inside the second chunk (round 5 of 12 at width
+    # 3); the drain must flush the pipeline to the round it reports.
+    assert drained == exc.value.round == 5
+    res = run_experiment(cfg, verbose=False, resume=True)
+    assert res.rounds_run == 12 and not res.diverged
+    _assert_bitwise(baseline, res)
+
+
+def test_mpmd_dropout_fault_stays_bitwise_with_oracle():
+    """client_dropout edits the live batch mask in place for one round;
+    the metrics sub-program must see the SAME mask the oracle's
+    in-graph masked_client_mean sees (a build-time nonempty snapshot
+    would diverge here)."""
+    plan = json.dumps({"seed": 0, "faults": [
+        {"kind": "client_dropout", "round": 4, "clients": [1]}]})
+    mono = run_experiment(_cfg(fault_plan=plan), verbose=False)
+    mp = run_experiment(_cfg(mpmd=True, fault_plan=plan), verbose=False)
+    assert mp.rounds_run == mono.rounds_run == 12
+    _assert_bitwise(mono, mp)
+
+
+@pytest.mark.parametrize("run_kw,match", [
+    ({"pipelined_stop": True}, "subsumes"),
+    ({"overlap_compile": True}, "overlap_compile"),
+    ({"on_divergence": "rollback", "checkpoint_dir": "d",
+      "checkpoint_every": 2}, "rollback"),
+    ({"model_parallel": 2}, "model_parallel"),
+])
+def test_mpmd_invalid_run_configs_rejected(run_kw, match):
+    with pytest.raises(ValueError, match=match):
+        run_experiment(_cfg(mpmd=True, **run_kw), verbose=False)
+
+
+@pytest.mark.parametrize("fed_kw,match", [
+    ({"async_mode": True, "weighting": "uniform"}, "async_mode"),
+    ({"server_opt": "fedadam"}, "server_opt"),
+    ({"scaffold": True}, "scaffold"),
+    ({"participation_rate": 0.5}, "participation_rate"),
+])
+def test_mpmd_invalid_fed_configs_rejected(fed_kw, match):
+    base = _cfg(mpmd=True)
+    cfg = dataclasses.replace(base,
+                              fed=dataclasses.replace(base.fed, **fed_kw))
+    with pytest.raises(ValueError, match=match):
+        run_experiment(cfg, verbose=False)
+
+
+def test_mpmd_parity_check_probe():
+    """The `fedtpu check --mpmd` fold's probe: ok=True with no
+    mismatches on the standard preset shrunk to synthetic data."""
+    from fedtpu.orchestration.mpmd import parity_check
+    rep = parity_check("income-8", rounds=4, synthetic_rows=256)
+    assert rep["ok"]
+    assert rep["metric_mismatches"] == []
+    assert rep["param_leaf_mismatches"] == 0
+    assert rep["rounds_run"] == [4, 4]
+
+
+def test_mpmd_trace_chains_and_chrome_export(tmp_path):
+    """Each chunk's pass through the DAG is one trace-id chain in the
+    PR 16 timeline — client_step -> aggregate -> metrics in causal
+    order — and the Chrome/Perfetto export renders the stage slices."""
+    from fedtpu.config import TelemetryConfig
+    from fedtpu.telemetry.timeline import (chrome_trace, load_timeline,
+                                           trace_chains)
+    ev = str(tmp_path / "events.jsonl")
+    cfg = _cfg(mpmd=True, telemetry=TelemetryConfig(events_path=ev))
+    run_experiment(cfg, verbose=False)
+    sources = load_timeline([ev])
+    chains = [c for c in trace_chains(sources)
+              if str(c["chain"]).startswith("mpmd-")]
+    assert len(chains) == 4                    # 12 rounds at width 3
+    for c in chains:
+        assert [s["stage"] for s in c["stages"]] == [
+            "client_step", "aggregate", "metrics"]
+        assert all(s["op"] == "mpmd" for s in c["stages"])
+    names = {e.get("name") for e in chrome_trace(sources)["traceEvents"]}
+    assert {"trace:client_step", "trace:aggregate",
+            "trace:metrics"} <= names
+
+
+def test_mpmd_manifest_records_dag(tmp_path):
+    """The run manifest names the engine and the DAG's sub-programs, and
+    keeps the audited-program caveat honest (the runtime audit summary
+    gates the monolithic ORACLE; the per-sub-program contracts live in
+    the committed mpmd_* goldens)."""
+    from fedtpu.config import TelemetryConfig
+    from fedtpu.telemetry.report import aggregate, load_events
+    ev = str(tmp_path / "events.jsonl")
+    cfg = _cfg(mpmd=True, telemetry=TelemetryConfig(events_path=ev))
+    run_experiment(cfg, verbose=False)
+    agg = aggregate(load_events(ev)[0])
+    man = agg["manifest"]
+    assert man["engine"] == "mpmd"
+    assert man["mpmd"]["width"] == 3
+    assert man["mpmd"]["sub_programs"] == sorted(
+        ["mpmd_client", "mpmd_aggregate", "mpmd_chain", "mpmd_metrics"])
+    assert agg["static_analysis"]["audited_program"] == "monolithic_oracle"
+    assert agg["static_analysis"]["engine"] == "mpmd_chain"

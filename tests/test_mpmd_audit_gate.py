@@ -1,0 +1,82 @@
+"""The MPMD per-sub-program audit gate: `fedtpu audit <preset>
+--engines mpmd_client,mpmd_aggregate,mpmd_chain,mpmd_metrics`.
+
+The MPMD DAG (fedtpu/orchestration/mpmd.py) splits the round into four
+AOT sub-programs, and each one's collective schedule is gated
+INDEPENDENTLY here — a psum leaking into the client step or the metrics
+program (both contractually collective-free), a dropped donation, or a
+perturbed chain schedule shows up as a golden diff.  These goldens are
+SEPARATE files from audit_<preset>.json on purpose: the default engine
+set (sync/async/tp/cohort) is pinned by tests/test_audit_gate.py and
+must not grow.
+
+Generated under the hermetic suite env (CPU backend, 8 virtual devices
+— tests/conftest.py) via:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m fedtpu.cli audit <preset> --synthetic-rows 256 \
+        --engines mpmd_client,mpmd_aggregate,mpmd_chain,mpmd_metrics \
+        --write-golden tests/goldens/audit_mpmd_<preset>.json
+
+Regenerate the same way after an INTENDED schedule change and review
+the diff like any other golden.
+"""
+
+import json
+import os
+
+import pytest
+
+from fedtpu.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDENS = os.path.join(REPO, "tests", "goldens")
+# income-4 pins the post-reshard topology alongside its parent, same as
+# the monolithic gate.
+PRESETS = ("income-4", "income-8")
+ENGINES = "mpmd_client,mpmd_aggregate,mpmd_chain,mpmd_metrics"
+
+
+def _golden_path(preset):
+    return os.path.join(GOLDENS, f"audit_mpmd_{preset}.json")
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_mpmd_audit_matches_committed_golden(preset, capsys):
+    rc = cli_main(["audit", preset, "--synthetic-rows", "256",
+                   "--engines", ENGINES,
+                   "--golden", _golden_path(preset)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"fedtpu audit diverged from its golden:\n{out}"
+    assert f"golden: matches {_golden_path(preset)}" in out
+
+
+def test_mpmd_goldens_are_clean_contracts():
+    """The committed contracts themselves, plus the DAG's structural
+    invariants: the client step and the metrics program are
+    collective-free in the jaxpr; the aggregate and the chain own the
+    clients-axis reductions; the chain's per-round schedule is the
+    aggregate's (one reduction set per scanned round)."""
+    for preset in PRESETS:
+        with open(_golden_path(preset), encoding="utf-8") as fh:
+            golden = json.load(fh)
+        assert golden["ok"] and golden["findings"] == [], preset
+        eng = golden["engines"]
+        assert set(eng) == {"mpmd_client", "mpmd_aggregate",
+                            "mpmd_chain", "mpmd_metrics"}
+        for name, contract in eng.items():
+            assert "skipped" not in contract, (preset, name)
+        # The whole point of the decomposition: the client step
+        # dispatches without waiting on any cross-device phase.
+        assert eng["mpmd_client"]["schedule"] == []
+        assert eng["mpmd_metrics"]["schedule"] == []
+        assert eng["mpmd_aggregate"]["comm_bytes_per_round"] > 0
+        assert eng["mpmd_chain"]["comm_bytes_per_round"] > 0
+        # One reduction set per scanned round: same ops, same per-trip
+        # bytes, more trips.
+        def op_set(contract):
+            return {(s["op"], tuple(s["axes"]), tuple(map(tuple,
+                                                          s["shapes"])))
+                    for s in contract["schedule"]}
+        assert op_set(eng["mpmd_aggregate"]) == op_set(eng["mpmd_chain"]), \
+            preset
