@@ -887,6 +887,15 @@ def build_parser() -> argparse.ArgumentParser:
     check_p.add_argument("--lockdep-golden", default=None, metavar="GOLDEN",
                          help="golden lock graph for --lockdep (default: "
                               "tests/goldens/lockdep.json)")
+    check_p.add_argument("--fuzz-corpus", default=None, metavar="DIR",
+                         nargs="?", const="tests/corpus",
+                         help="also replay every committed fuzz campaign "
+                              "under DIR (default tests/corpus): digest "
+                              "must match the entries, every oracle must "
+                              "pass, two same-seed runs must be bitwise, "
+                              "and the verdict artifact must match its "
+                              "committed golden — folded into the exit "
+                              "code")
 
     # IR-level program audit: trace the real engines, extract and verify
     # the collective schedule, prove donation, account comm bytes
@@ -1039,6 +1048,43 @@ def build_parser() -> argparse.ArgumentParser:
                          help="print the matrix report as one JSON line")
     chaos_p.add_argument("--quiet", action="store_true",
                          help="suppress per-scenario progress lines")
+
+    # Compositional chaos fuzzing: seeded multi-fault campaigns against
+    # the deterministic in-process gang, judged by the oracle library,
+    # failures ddmin-shrunk to committed reproducers
+    # (fedtpu.resilience.fuzz; docs/resilience.md).
+    fuzz_p = sub.add_parser("fuzz",
+                            help="sample seeded COMPOSED fault campaigns "
+                                 "(process + wire + lifecycle + poison) "
+                                 "and replay each against a deterministic "
+                                 "two-gateway gang, judged by the "
+                                 "invariant-oracle library; failing "
+                                 "campaigns are delta-debugged to minimal "
+                                 "reproducers (docs/resilience.md)")
+    fuzz_p.add_argument("--budget", type=_positive_int, default=25,
+                        help="campaigns to sample and replay (default 25)")
+    fuzz_p.add_argument("--seed", type=int, default=0,
+                        help="campaign-generator seed (default 0): the "
+                             "run is a pure function of (seed, budget)")
+    fuzz_p.add_argument("--rounds", type=_positive_int, default=8,
+                        help="virtual rounds per campaign (default 8)")
+    fuzz_p.add_argument("--campaign", default=None, metavar="SPEC",
+                        help="replay ONE campaign instead of sampling: a "
+                             "manifest path or inline JSON (digest "
+                             "verified when present)")
+    fuzz_p.add_argument("--shrink-to", default=None, metavar="DIR",
+                        help="write each failing campaign's ddmin-minimal "
+                             "reproducer + bitwise verdict golden under "
+                             "DIR (the tests/corpus layout)")
+    fuzz_p.add_argument("--no-shrink", action="store_true",
+                        help="report failures without delta-debugging "
+                             "them")
+    fuzz_p.add_argument("--events", default=None, metavar="PATH",
+                        help="append one fuzz_campaign event per campaign "
+                             "(plus the fuzz_run summary) to this JSONL "
+                             "for 'fedtpu report'")
+    fuzz_p.add_argument("--json", action="store_true",
+                        help="print the fuzz report as one JSON line")
 
     # Serving front-end: a long-running ingestion process feeding the
     # async FedBuff engine from real (traced) arrivals instead of the
@@ -1367,6 +1413,56 @@ def main(argv=None) -> int:
             print(json.dumps(report, default=float))
         return 0 if report["ok"] else 1
 
+    if args.cmd == "fuzz":
+        from fedtpu.config import FuzzConfig
+        from fedtpu.resilience.fuzz import (Campaign, emit_event,
+                                            run_campaign, run_fuzz)
+        fcfg = FuzzConfig(budget=args.budget, seed=args.seed,
+                          rounds=args.rounds, shrink=not args.no_shrink)
+        if args.campaign:
+            c = Campaign.load(args.campaign)
+            res = run_campaign(c, cfg=fcfg)
+            if args.events:
+                emit_event(args.events, "fuzz_campaign",
+                           {"name": c.name, "digest": c.digest,
+                            "ok": res["ok"], "failed": res["failed"],
+                            "fired": res["summary"]["fired"]})
+            if args.json:
+                print(json.dumps({"ok": res["ok"], "failed": res["failed"],
+                                  "verdicts": res["verdicts"],
+                                  "summary": res["summary"]},
+                                 default=float))
+            else:
+                s = res["summary"]
+                print(f"campaign {s['digest']}: "
+                      f"{'OK' if res['ok'] else 'VIOLATION'} "
+                      f"({len(res['verdicts'])} oracles"
+                      + (f"; failed {res['failed']}" if res["failed"]
+                         else "") + ")")
+                print(f"  admitted {s['client_admitted']}, incorporated "
+                      f"{s['incorporated']}, screened {s['screened']}, "
+                      f"lost_acked {s['lost_acked']}, retried "
+                      f"{s['retried']}, restarts {s['restarts']}")
+            return 0 if res["ok"] else 1
+        report = run_fuzz(budget=args.budget, seed=args.seed, cfg=fcfg,
+                          out_dir=args.shrink_to, events=args.events,
+                          shrink=not args.no_shrink)
+        if args.json:
+            print(json.dumps(report, default=float))
+        else:
+            print(f"fuzz seed {report['seed']}: {report['passed']}/"
+                  f"{report['campaigns']} campaigns passed all oracles")
+            for r in report["rows"]:
+                if not r["ok"]:
+                    tail = (f" -> minimized to {r['shrunk_entries']} "
+                            f"entries in {r['shrink_runs']} runs"
+                            if "minimized" in r else "")
+                    print(f"  VIOLATION {r['name']} ({r['digest']}): "
+                          f"{r.get('failed')}{tail}")
+                    if "reproducer" in r:
+                        print(f"    reproducer: {r['reproducer']}")
+        return 0 if report["ok"] else 1
+
     if args.cmd == "loadgen":
         # Before the platform pin: the loadgen never imports jax — it can
         # hammer a server from a machine with no backend at all.
@@ -1690,6 +1786,16 @@ def main(argv=None) -> int:
                 "drills": ran, "locks": sorted(graph.nodes),
                 "edges": len(graph.edges), "cycles": cycles}
             report["ok"] = report["ok"] and ok
+        if args.fuzz_corpus:
+            # Fold the committed fuzz corpus into the check: every
+            # minimized reproducer must still pass every oracle, replay
+            # bitwise across two same-seed runs, and match its committed
+            # verdict golden — a campaign-digest mismatch (hand-edited
+            # manifest) fails the gate loudly.
+            from fedtpu.resilience.fuzz import run_corpus
+            fc = run_corpus(args.fuzz_corpus)
+            report["fuzz_corpus"] = fc
+            report["ok"] = report["ok"] and fc["ok"]
         if args.json:
             print(json.dumps(report))
         else:
@@ -1735,6 +1841,13 @@ def main(argv=None) -> int:
                     state = ("up" if r["ok"]
                              else r.get("error", "unreachable"))
                     print(f"gateway {r['gateway']}: {state}")
+            if "fuzz_corpus" in report:
+                fc = report["fuzz_corpus"]
+                print(f"fuzz-corpus: ok={fc['ok']} "
+                      f"campaigns={fc['campaigns']} ({fc['corpus']})")
+                for r in fc["rows"]:
+                    if not r["ok"]:
+                        print(f"  {r['name']}: {r['reason']}")
             if "lockdep" in report:
                 ld = report["lockdep"]
                 print(f"lockdep: ok={ld['ok']} ({ld['reason']}) "

@@ -466,6 +466,28 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FuzzConfig:
+    """`fedtpu fuzz` — compositional chaos fuzzing
+    (fedtpu.resilience.fuzz; docs/resilience.md "Chaos fuzzing").
+
+    Sizing knobs for the deterministic two-gateway campaign executor.
+    Everything here is part of a campaign's replay frame: the corpus
+    gate (`fedtpu check --fuzz-corpus`) replays committed campaigns
+    under the DEFAULTS, so changing one legitimately regenerates the
+    corpus verdict goldens."""
+
+    budget: int = 25              # campaigns per fuzz run
+    seed: int = 0                 # campaign-sampler seed
+    rounds: int = 8               # traffic rounds per campaign
+    users: int = 32               # user population behind the trace
+    arrivals_per_round: int = 24  # trace rows per round (split by owner)
+    gateways: int = 2             # fleet width (the 2-process gang)
+    ckpt_every: int = 3           # checkpoint cadence (rounds)
+    burn_budget: float = 8.0      # slo_burn_bounded oracle ceiling
+    shrink: bool = True           # ddmin failing campaigns to reproducers
+
+
+@dataclasses.dataclass(frozen=True)
 class AutoscaleConfig:
     """`fedtpu autoscale` — the SLO-driven control plane
     (fedtpu.autoscale; docs/autoscale.md).

@@ -21,6 +21,18 @@ package makes failure a first-class, testable input:
   (SIGKILL, preemption, NaN rollback, dropout, straggler, plus the
   multi-process gang scenarios) with per-scenario survival/recovery
   reporting.
+* :mod:`fedtpu.resilience.oracles` — the invariant-oracle library: each
+  resilience bar (exactly-once incorporation, zero lost acked updates,
+  bitwise history, the exit-code contract, monotone rounds, checkpoint
+  restorability, bounded SLO burn) as ONE pure function returning a
+  structured Verdict, shared by the chaos rows, the fuzzer, and the
+  corpus gate.
+* :mod:`fedtpu.resilience.fuzz` — ``fedtpu fuzz``: seeded COMPOSED
+  multi-fault campaigns (process + wire + lifecycle + poison in one
+  digest-stamped artifact) replayed against a deterministic in-process
+  two-gateway gang, judged by the oracles, with ddmin delta-debugging
+  to minimal reproducers committed under tests/corpus/ and replayed
+  bitwise by ``fedtpu check --fuzz-corpus``.
 
 See docs/resilience.md for the fault taxonomy and recovery semantics.
 """
@@ -30,11 +42,13 @@ from fedtpu.resilience.distributed import (CollectiveWatchdog,
                                            heartbeat_path_for)
 from fedtpu.resilience.supervisor import (EXIT_DIVERGED, EXIT_OK,
                                           EXIT_PREEMPTED, Preempted,
-                                          read_heartbeat, supervise,
-                                          supervise_gang, write_heartbeat)
+                                          read_heartbeat, restart_backoff,
+                                          supervise, supervise_gang,
+                                          write_heartbeat)
 
 __all__ = [
     "EXIT_OK", "EXIT_DIVERGED", "EXIT_PREEMPTED", "Preempted",
-    "read_heartbeat", "write_heartbeat", "supervise", "supervise_gang",
-    "CollectiveWatchdog", "agree_resume_step", "heartbeat_path_for",
+    "read_heartbeat", "write_heartbeat", "restart_backoff", "supervise",
+    "supervise_gang", "CollectiveWatchdog", "agree_resume_step",
+    "heartbeat_path_for",
 ]

@@ -143,6 +143,8 @@ import sys
 import tempfile
 from typing import List, Optional, Sequence
 
+from fedtpu.resilience import oracles
+
 # THE scenario registry: every chaos row declares its name, its family
 # tags, and its one-line help HERE, once. ``SCENARIOS``, the per-family
 # tuples below, run_chaos's verbose detail lines, and the CLI's
@@ -630,15 +632,16 @@ def _run_gateway_kill(workdir: str, platform: str, timeout: int) -> dict:
         row["restarts"] = res.get("restarts") or 0
         row["gang_restarts"] = res.get("gang_restarts") or 0
         row["survived"] = sup_rc in (0, 75) and len(stats) == 2
-        row["ok"] = (row["survived"]
-                     and row["retried"] >= 1
-                     and row["gang_restarts"] >= 1
-                     and row["duplicate_drops"] >= 1
-                     and row["lost_acked"] == 0
-                     and client_admitted == fleet_admitted
-                     and row["backlog"] == 0
-                     and row["slo_burn"] is not None
-                     and row["slo_burn"] <= GATEWAY_BURN_BUDGET)
+        verdicts = oracles.judge_gateway_kill(
+            survived=row["survived"], retried=row["retried"],
+            gang_restarts=row["gang_restarts"],
+            duplicate_drops=row["duplicate_drops"],
+            lost_acked=row["lost_acked"],
+            client_admitted=client_admitted,
+            fleet_admitted=fleet_admitted, backlog=row["backlog"],
+            slo_burn=row["slo_burn"], burn_budget=GATEWAY_BURN_BUDGET)
+        row["oracles"] = [v.as_dict() for v in verdicts]
+        row["ok"] = oracles.summarize(verdicts)["ok"]
         if not row["ok"]:
             stderr_parts.append((sup.stderr.read() or "")
                                 if sup.stderr else "")
@@ -962,16 +965,17 @@ def _run_net_row(name: str, workdir: str, platform: str,
     row["netlog_match"] = (len(passes) == 2 and bool(a["netlog"])
                            and a["netlog"] == passes[1]["netlog"])
     row["history_match"] = row["netlog_match"]
-    row["ok"] = (row["survived"]
-                 and row["netlog_match"]
-                 and row["retried"] >= 1
-                 and row["duplicate_drops"] >= 1
-                 and row["lost_acked"] == 0
-                 and a.get("client_admitted") == a.get("fleet_admitted")
-                 and row["backlog"] == 0
-                 and row["gang_restarts"] == 0
-                 and row["slo_burn"] is not None
-                 and row["slo_burn"] <= NET_BURN_BUDGET)
+    verdicts = oracles.judge_net_row(
+        survived=row["survived"], netlog_match=row["netlog_match"],
+        retried=row["retried"],
+        duplicate_drops=row["duplicate_drops"],
+        lost_acked=row["lost_acked"],
+        client_admitted=a.get("client_admitted"),
+        fleet_admitted=a.get("fleet_admitted"), backlog=row["backlog"],
+        gang_restarts=row["gang_restarts"], slo_burn=row["slo_burn"],
+        burn_budget=NET_BURN_BUDGET)
+    row["oracles"] = [v.as_dict() for v in verdicts]
+    row["ok"] = oracles.summarize(verdicts)["ok"]
     return row
 
 
@@ -1095,13 +1099,20 @@ def _run_poison_campaign(workdir: str, platform: str, timeout: int) -> dict:
     row["accuracy_clean"] = c["accuracy_min"]
     row["gang_restarts"] = max(p["gang_restarts"] for p in passes.values())
     row["survived"] = True
-    row["ok"] = (not row["missed_attackers"]
-                 and not row["quarantined_honest"]
-                 and row["gang_restarts"] == 0
-                 and d["accuracy_min"] >= c["accuracy_min"]
-                 - POISON_ACCURACY_TOL
-                 and u["accuracy_min"] <= c["accuracy_min"]
-                 - POISON_DEGRADE_MIN)
+    verdicts = [
+        oracles.quarantine_containment(d["quarantined"], atk,
+                                       mode="exact"),
+        oracles.Verdict("no_gang_restart", row["gang_restarts"] == 0,
+                        observed=row["gang_restarts"], expected=0,
+                        detail="defense must absorb the attack without a "
+                               "restart"),
+        oracles.defense_effective(d["accuracy_min"], u["accuracy_min"],
+                                  c["accuracy_min"],
+                                  POISON_ACCURACY_TOL,
+                                  POISON_DEGRADE_MIN),
+    ]
+    row["oracles"] = [v.as_dict() for v in verdicts]
+    row["ok"] = oracles.summarize(verdicts)["ok"]
     return row
 
 
@@ -1165,26 +1176,20 @@ def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
     hist = _history(os.path.join(workdir, f"{name}.metrics.jsonl"))
     res = _resilience(os.path.join(workdir, f"{name}.events.jsonl"))
     k = _fault_round(rounds)
-    prefix_ok = all(hist.get(r) == baseline.get(r) for r in range(1, k))
-    full_ok = (sorted(hist) == sorted(baseline)
-               and all(hist[r] == baseline[r] for r in hist))
-    if name == "dropout":
-        # The dropped round must CHANGE the aggregate — identical history
-        # would mean the fault silently didn't apply.
-        history_ok = (prefix_ok and sorted(hist) == sorted(baseline)
-                      and hist.get(k) != baseline.get(k))
-    elif name in ("mp_shrink", "mp_grow"):
-        # Live reshard: every round exists and the pre-notice prefix is
-        # bitwise, but rounds trained on the resized gang aggregate a
-        # different client set — full equality would mean the reshard
-        # silently didn't happen.
-        history_ok = (prefix_ok and sorted(hist) == sorted(baseline)
-                      and hist.get(k) != baseline.get(k))
+    if name in ("dropout", "mp_shrink", "mp_grow"):
+        # The dropped round / resized gang must CHANGE the aggregate at
+        # the fault round — identical history would mean the fault (or
+        # the reshard) silently didn't apply — while the pre-fault
+        # prefix stays bitwise.
+        hist_verdict = oracles.history_bitwise(
+            hist, baseline, mode="prefix_divergent", fault_round=k)
     else:
         # mp_shrink_dead lands here on purpose: the aborted reshard must
         # leave NO trace in the math — gang restart + resume replays the
         # whole tail bitwise, exactly the mp_kill_worker bar.
-        history_ok = full_ok
+        hist_verdict = oracles.history_bitwise(hist, baseline,
+                                               mode="full")
+    history_ok = hist_verdict.ok
     row = {
         "scenario": name,
         "rc": out.returncode,
@@ -1197,6 +1202,7 @@ def run_scenario(name: str, workdir: str, baseline: dict, rounds: int,
         "collective_hangs": len(res.get("collective_hangs") or []),
         "reshards": len(res.get("reshards") or []),
         "reshard_failures": len(res.get("reshard_failures") or []),
+        "oracles": [hist_verdict.as_dict()],
     }
     # The notice rows inject no injector-visible fault (the controller
     # consumes the notice), and the live rows must NOT gang-restart —

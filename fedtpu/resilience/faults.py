@@ -286,15 +286,23 @@ def perturb_params(params, attempt: int, scale: float):
     return _perturb_tree(params, jax.random.key(attempt), scale)
 
 
-def corrupt_checkpoint(directory: str, step: Optional[int] = None
-                       ) -> Optional[int]:
+def corrupt_checkpoint(directory: str, step: Optional[int] = None,
+                       mode: str = "stomp", fraction: Optional[float] = None,
+                       seed: int = 0) -> Optional[int]:
     """In-place corruption of the latest complete checkpoint's state
-    payload: truncate the largest file to half and stomp its header. The
-    round still looks committed (state/ and meta/ both exist) — exactly
-    the failure mode a dying disk produces — so only a restore attempt
-    (and the fallback walk in load_checkpoint_fallback) discovers it.
-    Returns the corrupted step, or None when there is nothing to corrupt.
+    payload. ``mode='stomp'`` (the historical behavior): truncate the
+    largest file to half and stomp its header. ``mode='torn'``: a torn
+    write — truncate the largest file to a SEEDED fraction of its bytes
+    (``fraction``, or drawn uniformly from [0.05, 0.6) by ``seed``) and
+    leave the surviving prefix byte-intact, the failure mode of a
+    power-cut mid-flush. Either way the round still looks committed
+    (state/ and meta/ both exist) — so only a restore attempt (and the
+    fallback walk in load_checkpoint_fallback) discovers it. Returns
+    the corrupted step, or None when there is nothing to corrupt.
     """
+    if mode not in ("stomp", "torn"):
+        raise ValueError(f"corrupt_checkpoint mode {mode!r}: "
+                         "pick 'stomp' or 'torn'")
     from fedtpu.orchestration.checkpoint import latest_step
     if step is None:
         step = latest_step(directory)
@@ -312,9 +320,15 @@ def corrupt_checkpoint(directory: str, step: Optional[int] = None
     if target is None:
         return None
     with open(target, "r+b") as fh:
-        fh.truncate(max(1, size // 2))
-        fh.seek(0)
-        fh.write(b"\xde\xad\xbe\xef" * 16)
+        if mode == "torn":
+            if fraction is None:
+                fraction = float(
+                    np.random.RandomState(seed).uniform(0.05, 0.6))
+            fh.truncate(max(1, int(size * float(fraction))))
+        else:
+            fh.truncate(max(1, size // 2))
+            fh.seek(0)
+            fh.write(b"\xde\xad\xbe\xef" * 16)
     return step
 
 

@@ -74,6 +74,22 @@ class Preempted(Exception):
         self.round = round_
 
 
+def restart_backoff(rc: int, hung: bool, crash_streak: int,
+                    backoff_base: float, backoff_max: float) -> float:
+    """Crash-restart delay for both :func:`supervise` and
+    :func:`supervise_gang`: a PURE function of the exit disposition and
+    the current crash streak — no wall clock, no jitter — so a fuzz
+    campaign that crosses a restart replays its schedule bitwise
+    (pinned by tests/test_fuzz.py). A preemption (exit 75) or a
+    heartbeat/watchdog-detected hang restarts immediately (the last
+    periodic checkpoint is intact); a crash backs off exponentially
+    from ``backoff_base``, capped at ``backoff_max``."""
+    if rc == EXIT_PREEMPTED or hung:
+        return 0.0
+    return min(float(backoff_max),
+               float(backoff_base) * (2.0 ** int(crash_streak)))
+
+
 def write_heartbeat(path: str, **payload) -> None:
     """Atomic heartbeat write (tmp + rename): the supervisor's liveness
     probe must never see a half-written file."""
@@ -282,8 +298,8 @@ def supervise(child_argv: Sequence[str], max_restarts: int = 2,
             # A heartbeat-detected hang is the same failure mode the
             # watchdog's exit 75 reports (the last periodic checkpoint
             # is intact) — both restart without backoff.
-            delay = (0.0 if rc == EXIT_PREEMPTED or hung
-                     else min(backoff_max, backoff_base * (2 ** crash_streak)))
+            delay = restart_backoff(rc, hung, crash_streak,
+                                    backoff_base, backoff_max)
             if delay:
                 crash_streak += 1
             restarts += 1
@@ -521,8 +537,8 @@ def supervise_gang(child_argv: Sequence[str], num_processes: int,
             # collective watchdog reports as exit 75 — the last periodic
             # checkpoint is intact, so restart without backoff exactly
             # like a preemption.
-            delay = (0.0 if rc == EXIT_PREEMPTED or hung
-                     else min(backoff_max, backoff_base * (2 ** crash_streak)))
+            delay = restart_backoff(rc, hung, crash_streak,
+                                    backoff_base, backoff_max)
             if delay:
                 crash_streak += 1
             restarts += 1

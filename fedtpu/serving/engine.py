@@ -479,7 +479,15 @@ class ServingEngine:
         once state is durable. No-op until ``wal_path`` is set. The
         frame's causal ``trace`` id is persisted with the entry (so a
         WAL replay re-offers under the original id) and emitted as the
-        'wal' trace stage."""
+        'wal' trace stage.
+
+        ``wal_shortwrite`` (when set: a callable ``(nonce, seq, line)
+        -> Optional[int]``) is the seeded disk-full injection hook used
+        by the chaos fuzzer: a non-None return truncates the append to
+        that many characters and raises ENOSPC, exactly a partial
+        ``os.write`` — replay_wal tears cleanly at the damaged tail and
+        the client's retry of the unacked frame dedups through the
+        session machinery."""
         if not self.wal_path:
             return
         import json
@@ -492,9 +500,18 @@ class ServingEngine:
             entry["trace"] = str(trace)
         self._trace("wal", trace, nonce=entry["nonce"], seq=entry["seq"],
                     events=len(entry["events"]))
+        line = json.dumps(entry, sort_keys=True,
+                          separators=(",", ":")) + "\n"
+        cut_fn = getattr(self, "wal_shortwrite", None)
+        cut = cut_fn(entry["nonce"], entry["seq"], line) if cut_fn else None
         with open(self.wal_path, "a", encoding="utf-8") as fh:
-            fh.write(json.dumps(entry, sort_keys=True,
-                                separators=(",", ":")) + "\n")
+            if cut is not None and int(cut) < len(line):
+                fh.write(line[:int(cut)])
+                fh.flush()
+                raise OSError(
+                    28, "No space left on device (simulated short "
+                        f"WAL append at {int(cut)}/{len(line)} chars)")
+            fh.write(line)
             fh.flush()
 
     def replay_wal(self) -> int:
@@ -1004,15 +1021,17 @@ class ServingEngine:
                 open(self.wal_path, "w").close()
         return path
 
-    def restore(self, directory: str) -> int:
+    def restore(self, directory: str, step: Optional[int] = None) -> int:
         """Restore engine + serving host state from the newest checkpoint
-        under ``directory`` (written by :meth:`checkpoint`). Returns the
-        restored tick count."""
+        under ``directory`` (written by :meth:`checkpoint`), or from the
+        specific ``step`` when given — the fallback walk in the chaos
+        fuzzer targets an OLDER round after the newest one turns out
+        torn. Returns the restored tick count."""
         from fedtpu.orchestration.checkpoint import (load_checkpoint,
                                                      load_meta)
-        state, history, step = load_checkpoint(directory,
+        state, history, step = load_checkpoint(directory, step=step,
                                                state_like=self.state)
-        meta = load_meta(directory)
+        meta = load_meta(directory, step=step)
         self.state = state
         # Checkpointed history comes back as numpy scalars; .item() them
         # so resumed history rows serialize byte-identically to fresh ones.

@@ -51,6 +51,15 @@ def _percentiles(durs: List[float]) -> dict:
             "max_s": float(a.max())}
 
 
+def _merge_counts(dicts) -> dict:
+    """Sum a stream of {key: count} dicts into one sorted tally."""
+    total: dict = {}
+    for d in dicts:
+        for k, v in d.items():
+            total[k] = total.get(k, 0) + int(v)
+    return dict(sorted(total.items()))
+
+
 def aggregate(events: List[dict], malformed: int = 0) -> dict:
     """One pass over the events into the report dict (see module
     docstring). Counter/gauge/histogram totals come from the LAST
@@ -102,6 +111,8 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
     quarantines: List[dict] = []
     net_faults: List[dict] = []
     netproxy_summaries: List[dict] = []
+    fuzz_campaigns: List[dict] = []
+    fuzz_run: Optional[dict] = None
     for e in events:
         v = e.get("v")
         if isinstance(v, int) and v > EVENT_SCHEMA_VERSION:
@@ -245,6 +256,13 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
             net_faults.append(payload)
         elif kind == "netproxy_summary":
             netproxy_summaries.append(payload)
+        # Fuzz timeline (fedtpu.resilience.fuzz; docs/resilience.md):
+        # one fuzz_campaign event per replayed campaign, one fuzz_run
+        # summary at the end of the sweep.
+        elif kind == "fuzz_campaign":
+            fuzz_campaigns.append(payload)
+        elif kind == "fuzz_run":
+            fuzz_run = payload
 
     out: dict = {
         "events_total": len(events),
@@ -267,7 +285,30 @@ def aggregate(events: List[dict], malformed: int = 0) -> dict:
         "cohort": None,
         "autoscale": None,
         "static_analysis": None,
+        "fuzz": None,
     }
+    if fuzz_campaigns or fuzz_run:
+        violations = [c for c in fuzz_campaigns if not c.get("ok")]
+        # Which oracle tripped, how often — the violation histogram is
+        # the fuzzer's headline (what KIND of bug the space holds).
+        oracle_hits: dict = {}
+        for c in violations:
+            for o in c.get("failed") or []:
+                oracle_hits[o] = oracle_hits.get(o, 0) + 1
+        out["fuzz"] = {
+            "campaigns": len(fuzz_campaigns),
+            "passed": sum(1 for c in fuzz_campaigns if c.get("ok")),
+            "violations": [
+                {"name": c.get("name"), "digest": c.get("digest"),
+                 "failed": c.get("failed"),
+                 "shrunk_entries": c.get("shrunk_entries"),
+                 "reproducer": c.get("reproducer")}
+                for c in violations],
+            "failed_oracles": dict(sorted(oracle_hits.items())),
+            "fired": _merge_counts(c.get("fired") or {}
+                                   for c in fuzz_campaigns),
+            "summary": fuzz_run,
+        }
     if (autoscale_ticks or autoscale_acts or autoscale_summary
             or autoscale_pre_drains or serve_pre_drains or serve_configures):
         out["autoscale"] = {
@@ -589,6 +630,27 @@ def render_text(agg: dict) -> str:
         for key in ("redirects", "duplicate_drops", "oversized_lines"):
             if net.get(key) is not None:
                 lines.append(f"  {key}: {net[key]:g}")
+    fz = agg.get("fuzz")
+    if fz:
+        lines.append("fuzz (compositional chaos campaigns):")
+        lines.append(f"  campaigns: {fz.get('campaigns')} "
+                     f"({fz.get('passed')} passed all oracles)")
+        fired = ", ".join(f"{k}={v}" for k, v in
+                          sorted((fz.get("fired") or {}).items()))
+        if fired:
+            lines.append(f"  faults fired: {fired}")
+        oh = fz.get("failed_oracles") or {}
+        if oh:
+            lines.append("  failed oracles: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(oh.items())))
+        for v in fz.get("violations") or []:
+            tail = (f" -> {v['shrunk_entries']}-entry reproducer"
+                    if v.get("shrunk_entries") is not None else "")
+            lines.append(f"  VIOLATION {v.get('name')} "
+                         f"[{v.get('digest')}]: "
+                         f"{', '.join(v.get('failed') or [])}{tail}")
+            if v.get("reproducer"):
+                lines.append(f"    committed: {v['reproducer']}")
     srv = agg.get("serving")
     if srv:
         lines.append("serving:")

@@ -261,6 +261,20 @@ QUICK_TESTS = {
     # trace-chain parity runs stay full-tier.
     "test_mpmd.py::test_mpmd_width1_matches_monolithic_bitwise",
     "test_mpmd_audit_gate.py::test_mpmd_goldens_are_clean_contracts",
+    # round-14 modules
+    # compositional chaos fuzzing (PR 19): campaign digests, the oracle
+    # library, and the chaos-bar equivalence pins are backend-free,
+    # milliseconds; the multi-campaign sweep and ddmin-from-noise runs
+    # stay full-tier. The corpus bitwise-replay gate itself runs quick
+    # via test_corpus_campaigns... in the tier-1 flow (seconds).
+    "test_fuzz.py::test_campaign_digest_roundtrip",
+    "test_fuzz.py::test_sampler_is_deterministic_and_covers"
+    "_the_fault_space",
+    "test_fuzz.py::test_judge_gateway_kill_matches_legacy"
+    "_mp_gateway_kill_bar",
+    "test_fuzz.py::test_judge_net_row_matches_legacy_mp_torn_frame_bar",
+    "test_fuzz.py::test_restart_backoff_is_a_pure_function_of_exit"
+    "_and_streak",
 }
 
 

@@ -1,0 +1,514 @@
+"""Compositional chaos fuzzing: campaign artifacts, the invariant-oracle
+library, the deterministic two-gateway executor, ddmin shrinking, and
+the committed-corpus bitwise replay gate.
+
+Layers under test (docs/resilience.md "Chaos fuzzing"):
+
+- ``fedtpu.resilience.fuzz`` — digest-stamped Campaign artifacts, the
+  seeded sampler, the in-process gang executor (virtual frame/round
+  clocks, never wall time), ddmin, and ``run_corpus`` (the
+  ``fedtpu check --fuzz-corpus`` tier-1 gate over tests/corpus/);
+- ``fedtpu.resilience.oracles`` — one positive + one negative fixture
+  per oracle, and the composite judges pinned against the chaos rows'
+  historical boolean bars (mp_gateway_kill, mp_torn_frame);
+- ``fedtpu.resilience.faults`` — the ``torn`` ckpt_corrupt mode and the
+  fallback walk past a torn round;
+- ``fedtpu.serving.engine`` — seeded WAL short-writes: the damaged tail
+  tears cleanly on replay and the client retry dedups exactly once;
+- ``fedtpu.resilience.supervisor`` — restart backoff as a pure function
+  of (exit, hung, crash_streak), no wall-clock jitter.
+
+The multi-campaign sweep and ddmin-from-noise runs are full-tier only
+(`slow`); the quick tier keeps the corpus gate, the stale-WAL-tail
+violation demo, and one executor run per satellite.
+"""
+
+import copy
+import inspect
+import json
+import os
+
+import pytest
+
+from fedtpu.resilience import oracles
+from fedtpu.resilience.fuzz import (Campaign, run_campaign, run_corpus,
+                                    sample_campaign, shrink_campaign,
+                                    write_corpus_entry)
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "corpus")
+
+# The committed reproducer of the stale-WAL-tail rollback bug the fuzzer
+# found (fedtpu.resilience.fuzz module docstring): newest checkpoint
+# torn on disk + a later crash force the fallback walk to an older
+# round; replaying the WAL tail onto it would dedup away the client's
+# resends of the rolled-back frames.
+STALE_TAIL = {
+    "name": "stale_tail", "seed": 11, "rounds": 8,
+    "faults": [
+        {"kind": "ckpt_corrupt", "mode": "torn", "round": 6, "gateway": 0},
+        {"kind": "process_kill", "round": 7, "gateway": 0},
+    ],
+}
+
+
+# ---------------------------------------------------------------------------
+# campaign artifact: canonical form, digest, load
+
+
+def test_campaign_digest_roundtrip():
+    c = sample_campaign(7, 3)
+    again = Campaign.load(c.to_json())
+    assert again.digest == c.digest
+    assert again.canonical() == c.canonical()
+    # entry order is canonicalized away: a manifest with reordered
+    # entries is the SAME campaign
+    flipped = Campaign(name=c.name, seed=c.seed, rounds=c.rounds,
+                       poison_fraction=c.poison_fraction,
+                       faults=list(reversed(c.faults)),
+                       net_faults=list(reversed(c.net_faults)),
+                       notices=list(reversed(c.notices)))
+    assert flipped.digest == c.digest
+
+
+def test_campaign_digest_mismatch_fails_loudly():
+    c = sample_campaign(7, 3)
+    manifest = c.manifest()
+    manifest["faults"].append({"kind": "straggler", "round": 2,
+                               "gateway": 0, "delay_s": 1.0})
+    with pytest.raises(ValueError, match="digest mismatch"):
+        Campaign.from_dict(manifest)
+
+
+def test_sampler_is_deterministic_and_covers_the_fault_space():
+    a = [sample_campaign(5, i) for i in range(20)]
+    b = [sample_campaign(5, i) for i in range(20)]
+    assert [c.digest for c in a] == [c.digest for c in b]
+    # different seeds move the draw
+    assert sample_campaign(6, 0).digest != sample_campaign(5, 0).digest
+    kinds = set()
+    for c in a:
+        kinds |= {e["kind"] for e in c.faults}
+        kinds |= {e["kind"] for e in c.net_faults}
+        kinds |= {e["kind"] for e in c.notices}
+    # 20 draws must visit both fault families (full coverage is the
+    # sweep's job, not one seed's)
+    assert any(k.startswith("net_") for k in kinds)
+    assert any(not k.startswith("net_") for k in kinds)
+
+
+# ---------------------------------------------------------------------------
+# oracle library: one positive + one negative fixture per oracle
+
+
+def test_exactly_once_oracle():
+    assert oracles.exactly_once(10, 10).ok
+    v = oracles.exactly_once(10, 12)
+    assert not v.ok and v.observed == 12 and v.expected == 10
+    assert not oracles.exactly_once(None, 10).ok
+
+
+def test_no_lost_acked_oracle():
+    assert oracles.no_lost_acked(0).ok
+    assert not oracles.no_lost_acked(3).ok     # acked update vanished
+    assert not oracles.no_lost_acked(-2).ok    # double incorporation
+    assert not oracles.no_lost_acked(None).ok
+
+
+def test_history_bitwise_oracle_full_mode():
+    base = {1: "a", 2: "b", 3: "c"}
+    assert oracles.history_bitwise(dict(base), base).ok
+    v = oracles.history_bitwise({1: "a", 2: "X", 3: "c"}, base)
+    assert not v.ok and v.observed["first_divergence"] == 2
+    assert not oracles.history_bitwise({1: "a", 2: "b"}, base).ok
+
+
+def test_history_bitwise_oracle_prefix_divergent_mode():
+    base = {1: "a", 2: "b", 3: "c"}
+    hist = {1: "a", 2: "X", 3: "c"}
+    ok = oracles.history_bitwise(hist, base, mode="prefix_divergent",
+                                 fault_round=2)
+    assert ok.ok
+    # identical history means the fault silently didn't apply
+    assert not oracles.history_bitwise(dict(base), base,
+                                       mode="prefix_divergent",
+                                       fault_round=2).ok
+    # divergence BEFORE the fault round breaks the prefix bar
+    assert not oracles.history_bitwise({1: "Z", 2: "X", 3: "c"}, base,
+                                       mode="prefix_divergent",
+                                       fault_round=2).ok
+    with pytest.raises(ValueError):
+        oracles.history_bitwise(hist, base, mode="prefix_divergent")
+
+
+def test_exit_contract_oracle():
+    assert oracles.exit_contract([[137, 75, 0], [0]]).ok
+    assert oracles.exit_contract([[76], [0]]).ok
+    assert not oracles.exit_contract([[3, 0]]).ok      # diverged
+    assert not oracles.exit_contract([[0, 137]]).ok    # died at the end
+    assert not oracles.exit_contract([[42, 0]]).ok     # unknown transient
+    assert not oracles.exit_contract([[]]).ok          # no exit recorded
+
+
+def test_monotone_rounds_oracle():
+    assert oracles.monotone_rounds([1, 2, 2, 5]).ok
+    v = oracles.monotone_rounds([1, 4, 3], member=1)
+    assert not v.ok and v.observed["regression_at"] == 2
+    assert v.observed["member"] == 1
+
+
+def test_slo_burn_and_backlog_oracles():
+    assert oracles.slo_burn_bounded(1.5, 2.5).ok
+    assert not oracles.slo_burn_bounded(3.0, 2.5).ok
+    assert not oracles.slo_burn_bounded(None, 2.5).ok  # signal went dark
+    assert oracles.backlog_drained(0).ok
+    assert not oracles.backlog_drained(7).ok
+    assert not oracles.backlog_drained(None).ok
+
+
+def test_quarantine_containment_oracle():
+    assert oracles.quarantine_containment([3, 5], [3, 5]).ok
+    assert not oracles.quarantine_containment([3], [3, 5]).ok  # missed
+    assert not oracles.quarantine_containment([3, 9], [3]).ok  # honest hit
+    # subset mode: undershooting is fine, honest casualties are not
+    assert oracles.quarantine_containment([3], [3, 5], mode="subset").ok
+    assert not oracles.quarantine_containment([9], [3, 5],
+                                              mode="subset").ok
+
+
+def test_defense_effective_oracle():
+    assert oracles.defense_effective(0.80, 0.60, 0.82, 0.05, 0.10).ok
+    # defense leaked accuracy
+    assert not oracles.defense_effective(0.70, 0.60, 0.82, 0.05, 0.10).ok
+    # attack was toothless — the row proves nothing
+    assert not oracles.defense_effective(0.80, 0.80, 0.82, 0.05, 0.10).ok
+    assert not oracles.defense_effective(None, 0.6, 0.8, 0.05, 0.10).ok
+
+
+# ---------------------------------------------------------------------------
+# composite judges vs the chaos rows' historical boolean bars
+# (satellite: refactored rows' verdicts must be unchanged)
+
+
+def _legacy_gateway_kill_ok(f):
+    return (f["survived"] and f["retried"] >= 1
+            and f["gang_restarts"] >= 1 and f["duplicate_drops"] >= 1
+            and f["lost_acked"] == 0
+            and f["client_admitted"] == f["fleet_admitted"]
+            and f["backlog"] == 0 and f["slo_burn"] is not None
+            and f["slo_burn"] <= 2.5)
+
+
+def _legacy_net_row_ok(f):
+    return (f["survived"] and f["netlog_match"] and f["retried"] >= 1
+            and f["duplicate_drops"] >= 1 and f["lost_acked"] == 0
+            and f["client_admitted"] == f["fleet_admitted"]
+            and f["backlog"] == 0 and f["gang_restarts"] == 0
+            and f["slo_burn"] is not None and f["slo_burn"] <= 2.5)
+
+
+GATEWAY_KILL_PASS = dict(survived=True, retried=2, gang_restarts=1,
+                         duplicate_drops=14, lost_acked=0,
+                         client_admitted=192, fleet_admitted=192,
+                         backlog=0, slo_burn=1.2)
+TORN_FRAME_PASS = dict(survived=True, netlog_match=True, retried=1,
+                       duplicate_drops=14, lost_acked=0,
+                       client_admitted=192, fleet_admitted=192,
+                       backlog=0, gang_restarts=0, slo_burn=0.8)
+
+
+def test_judge_gateway_kill_matches_legacy_mp_gateway_kill_bar():
+    mutations = [{}, {"survived": False}, {"retried": 0},
+                 {"gang_restarts": 0}, {"duplicate_drops": 0},
+                 {"lost_acked": 3}, {"fleet_admitted": 190},
+                 {"backlog": 2}, {"slo_burn": None}, {"slo_burn": 9.0}]
+    for mut in mutations:
+        f = {**GATEWAY_KILL_PASS, **mut}
+        vs = oracles.judge_gateway_kill(**f, burn_budget=2.5)
+        assert oracles.summarize(vs)["ok"] == _legacy_gateway_kill_ok(f), \
+            f"verdict changed for mutation {mut}"
+
+
+def test_judge_net_row_matches_legacy_mp_torn_frame_bar():
+    mutations = [{}, {"survived": False}, {"netlog_match": False},
+                 {"retried": 0}, {"duplicate_drops": 0},
+                 {"lost_acked": 1}, {"client_admitted": 191},
+                 {"backlog": 1}, {"gang_restarts": 1},
+                 {"slo_burn": None}, {"slo_burn": 3.1}]
+    for mut in mutations:
+        f = {**TORN_FRAME_PASS, **mut}
+        vs = oracles.judge_net_row(**f, burn_budget=2.5)
+        assert oracles.summarize(vs)["ok"] == _legacy_net_row_ok(f), \
+            f"verdict changed for mutation {mut}"
+
+
+def test_verdicts_render_canonically():
+    vs = oracles.judge_net_row(**TORN_FRAME_PASS, burn_budget=2.5)
+    for v in vs:
+        d = v.as_dict()
+        # bitwise artifact requirement: canonical JSON twice is bytes-equal
+        assert (json.dumps(d, sort_keys=True)
+                == json.dumps(copy.deepcopy(d), sort_keys=True))
+        assert set(d) == {"oracle", "ok", "observed", "expected", "detail"}
+
+
+# ---------------------------------------------------------------------------
+# supervisor restart backoff: pure function, no wall-clock jitter
+# (satellite: regression pin)
+
+
+def test_restart_backoff_is_a_pure_function_of_exit_and_streak():
+    from fedtpu.resilience.supervisor import (EXIT_PREEMPTED,
+                                              restart_backoff)
+    seq = [restart_backoff(1, False, k, backoff_base=0.5, backoff_max=30.0)
+           for k in range(8)]
+    assert seq == [0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 30.0, 30.0]
+    # repeated evaluation is bitwise-identical — no jitter source at all
+    assert seq == [restart_backoff(1, False, k, backoff_base=0.5,
+                                   backoff_max=30.0) for k in range(8)]
+    # preemption and watchdog hangs restart immediately, whatever the streak
+    assert restart_backoff(EXIT_PREEMPTED, False, 5, 0.5, 30.0) == 0.0
+    assert restart_backoff(1, True, 5, 0.5, 30.0) == 0.0
+
+
+def test_both_supervisors_route_delay_through_restart_backoff():
+    # the pin that keeps the pure function wired in: neither supervise
+    # loop may grow its own inline backoff (or a jitter term) again
+    from fedtpu.resilience import supervisor
+    for fn in (supervisor.supervise, supervisor.supervise_gang):
+        src = inspect.getsource(fn)
+        assert "restart_backoff(" in src, fn.__name__
+        assert "random" not in src, fn.__name__
+
+
+# ---------------------------------------------------------------------------
+# torn checkpoints + WAL short writes (satellites) — real engine, no gang
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_factory():
+    from fedtpu.config import ServingConfig
+    from fedtpu.serving.engine import ServingEngine
+    from fedtpu.telemetry.metrics import MetricsRegistry
+
+    def make():
+        cfg = ServingConfig(cohort=8, buffer_size=2, tick_interval_s=0.5,
+                            data_rows=64, model_hidden=(8,), seed=0)
+        return ServingEngine(cfg, registry=MetricsRegistry())
+
+    return make
+
+
+def _feed(eng, nonce, seq, n=6, t0=0.0):
+    from fedtpu.serving.server import _handle
+    rows = [[u, t0 + 0.3 * u, 0.1] for u in range(n)]
+    return _handle(eng, {"op": "updates", "events": rows,
+                         "nonce": nonce, "seq": seq})
+
+
+def test_torn_ckpt_corrupt_mode_and_fallback_walk(tiny_engine_factory,
+                                                  tmp_path):
+    from fedtpu.orchestration.checkpoint import (complete_steps,
+                                                 load_checkpoint_fallback)
+    from fedtpu.resilience.faults import corrupt_checkpoint
+    ck = str(tmp_path / "ck")
+    eng = tiny_engine_factory()
+    _feed(eng, "n0", 1, t0=0.0)
+    eng.checkpoint(ck)
+    good_step = eng.tick_count
+    _feed(eng, "n0", 2, t0=10.0)
+    eng.checkpoint(ck)
+    steps = complete_steps(ck)
+    assert len(steps) == 2
+    # torn mode: seeded truncation, header left byte-intact — the round
+    # still LOOKS committed, only a restore attempt can tell
+    hit = corrupt_checkpoint(ck, mode="torn", seed=3)
+    assert hit == steps[-1]
+    assert complete_steps(ck) == steps
+    with pytest.warns(RuntimeWarning, match="failed to restore"):
+        _, _, landed = load_checkpoint_fallback(ck)
+    assert landed == good_step
+    # the torn mode is seeded: same seed, same surviving byte count
+    assert (corrupt_checkpoint(ck, step=hit, mode="torn", seed=3)
+            == hit)
+    # and the oracle sees the same thing the walk does
+    with pytest.warns(RuntimeWarning):
+        assert oracles.checkpoint_restorable(ck).ok
+    with pytest.raises(ValueError):
+        corrupt_checkpoint(ck, mode="lightning")
+
+
+def test_wal_short_write_tears_cleanly_and_retry_dedups(
+        tiny_engine_factory, tmp_path):
+    from fedtpu.serving.server import _handle
+    wal = str(tmp_path / "wal.jsonl")
+    eng = tiny_engine_factory()
+    eng.wal_path = wal
+    first = _feed(eng, "n0", 1, t0=0.0)
+    assert first["op"] == "acks" and not first.get("duplicate")
+    # disk fills mid-append of seq 2: a short write must surface as an
+    # OSError AFTER flushing the damaged prefix (that is what a real
+    # ENOSPC leaves behind)
+    eng.wal_shortwrite = lambda nonce, seq, line: 25
+    with pytest.raises(OSError):
+        _feed(eng, "n0", 2, t0=10.0)
+    eng.wal_shortwrite = None
+    raw = open(wal, encoding="utf-8").read()
+    assert len(raw.splitlines()[-1]) == 25          # the torn tail
+    # crash + recover: replay tears cleanly at the damaged line
+    eng2 = tiny_engine_factory()
+    eng2.wal_path = wal
+    replayed = eng2.replay_wal()
+    assert replayed == 6                            # seq 1's rows only
+    incorporated_before = eng2.signals()["incorporated"]
+    # client retries seq 1 (acked pre-crash): dedups, counts replayed
+    dup = _feed(eng2, "n0", 1, t0=0.0)
+    assert dup.get("duplicate") is True
+    assert dup["counts"] == first["counts"]
+    assert eng2.duplicate_drops >= 1
+    # the torn seq 2 was NEVER acked, so its retry is fresh work —
+    # incorporated exactly once
+    retry = _feed(eng2, "n0", 2, t0=10.0)
+    assert not retry.get("duplicate")
+    again = _feed(eng2, "n0", 2, t0=10.0)
+    assert again.get("duplicate") is True
+    _handle(eng2, {"op": "drain"})
+    sig = eng2.signals()
+    assert sig["incorporated"] > incorporated_before
+    assert sig["backlog"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the stale-WAL-tail violation the fuzzer found (fixed this PR)
+
+
+def test_stale_wal_tail_replay_loses_acked_updates_without_the_guard():
+    c = Campaign.from_dict(STALE_TAIL)
+    bad = run_campaign(c, replay_stale_wal_tail=True)
+    assert not bad["ok"]
+    # two independent oracles catch it: the fleet admitted less than the
+    # client was told, and acked rows are gone from the incorporated sum
+    assert "exactly_once" in bad["failed"]
+    assert "no_lost_acked" in bad["failed"]
+    assert bad["summary"]["lost_acked"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the committed corpus: bitwise replay gate (tier-1 acceptance)
+
+
+def test_corpus_campaigns_replay_bitwise_and_pass_all_oracles():
+    report = run_corpus(CORPUS_DIR)
+    assert report["campaigns"] >= 2
+    for row in report["rows"]:
+        assert row["ok"], (row["name"], row["reason"])
+        assert row["replay_bitwise"], row["name"]
+        assert row["golden_ok"], row["name"]
+    assert report["ok"]
+
+
+def test_corpus_gate_rejects_a_tampered_manifest(tmp_path):
+    src = sorted(p for p in os.listdir(CORPUS_DIR)
+                 if p.endswith(".json"))[0]
+    with open(os.path.join(CORPUS_DIR, src), encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    manifest["faults"] = (manifest.get("faults") or []) + [
+        {"kind": "straggler", "round": 2, "gateway": 0, "delay_s": 9.9}]
+    tampered = tmp_path / src
+    tampered.write_text(json.dumps(manifest))
+    report = run_corpus(str(tmp_path))
+    assert not report["ok"]
+    assert "digest mismatch" in report["rows"][0]["reason"]
+
+
+def test_corpus_gate_fails_on_an_empty_directory(tmp_path):
+    report = run_corpus(str(tmp_path))
+    assert not report["ok"]
+    assert "no campaigns" in report["reason"]
+
+
+# ---------------------------------------------------------------------------
+# report: the fuzz section
+
+
+def test_report_aggregates_and_renders_the_fuzz_section():
+    from fedtpu.telemetry.report import aggregate, render_text
+    events = [
+        {"v": 1, "kind": "fuzz_campaign",
+         "payload": {"name": "c0000_000", "digest": "aa", "ok": True,
+                     "failed": [], "entries": 3,
+                     "fired": {"process_kill": 1, "net_reset": 2}}},
+        {"v": 1, "kind": "fuzz_campaign",
+         "payload": {"name": "c0000_001", "digest": "bb", "ok": False,
+                     "failed": ["no_lost_acked"], "entries": 5,
+                     "fired": {"ckpt_corrupt": 1}, "shrunk_entries": 2,
+                     "reproducer": "tests/corpus/c0000_001_min.json"}},
+        {"v": 1, "kind": "fuzz_run",
+         "payload": {"ok": True, "campaigns": 2, "passed": 1,
+                     "failed": ["c0000_001"], "seed": 0}},
+    ]
+    agg = aggregate(events)
+    fz = agg["fuzz"]
+    assert fz["campaigns"] == 2 and fz["passed"] == 1
+    assert fz["failed_oracles"] == {"no_lost_acked": 1}
+    assert fz["fired"] == {"ckpt_corrupt": 1, "net_reset": 2,
+                           "process_kill": 1}
+    text = render_text(agg)
+    assert "fuzz (compositional chaos campaigns)" in text
+    assert "VIOLATION c0000_001" in text
+    assert "2-entry reproducer" in text
+
+
+# ---------------------------------------------------------------------------
+# full-tier: sweeps and ddmin from noise
+
+
+@pytest.mark.slow
+def test_fuzz_sweep_every_campaign_passes_or_shrinks(tmp_path):
+    from fedtpu.resilience.fuzz import run_fuzz
+    events = str(tmp_path / "events.jsonl")
+    report = run_fuzz(budget=4, seed=3, events=events)
+    assert report["ok"]
+    assert report["campaigns"] == 4
+    with open(events, encoding="utf-8") as fh:
+        lines = [json.loads(ln) for ln in fh]
+    assert sum(1 for e in lines if e["kind"] == "fuzz_campaign") == 4
+    assert lines[-1]["kind"] == "fuzz_run"
+
+
+@pytest.mark.slow
+def test_ddmin_shrinks_noise_down_to_the_essential_pair(tmp_path):
+    noisy = Campaign(
+        name="noisy", seed=11, rounds=8,
+        faults=STALE_TAIL["faults"] + [
+            {"kind": "straggler", "round": 3, "gateway": 1,
+             "delay_s": 1.0},
+            {"kind": "client_dropout", "round": 2, "frac": 0.25}],
+        net_faults=[{"kind": "net_torn_frame", "gateway": 1, "frame": 4,
+                     "boundary": "post_ack", "cut_bytes": 48},
+                    {"kind": "net_dup_frame", "gateway": 0, "frame": 9}],
+        notices=[{"kind": "preempt_notice", "round": 4, "gateway": 1}])
+
+    def unguarded_fails(c):
+        return not run_campaign(c, replay_stale_wal_tail=True)["ok"]
+
+    assert unguarded_fails(noisy)
+    mini = shrink_campaign(noisy, predicate=unguarded_fails)
+    mc = mini["campaign"]
+    assert mini["removed"] == 5
+    assert mc.faults == STALE_TAIL["faults"]
+    assert mc.net_faults == [] and mc.notices == []
+    # and the minimized reproducer round-trips through the corpus layout
+    res = run_campaign(mc)
+    paths = write_corpus_entry(mc, res["artifact"], str(tmp_path))
+    gate = run_corpus(str(tmp_path))
+    assert gate["ok"], gate["rows"]
+    assert os.path.exists(paths["golden"])
+
+
+@pytest.mark.slow
+def test_campaign_replay_is_bitwise_across_runs():
+    c = sample_campaign(3, 6)   # ckpt_corrupt+preempt+short-write combo
+    a = run_campaign(c)
+    b = run_campaign(c)
+    assert a["lines"] == b["lines"]
+    assert a["artifact"] == b["artifact"]
